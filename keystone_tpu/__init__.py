@@ -1,0 +1,49 @@
+"""keystone_tpu — a TPU-native (JAX/XLA/Pallas/pjit) large-scale ML pipeline
+framework with the capabilities of KeystoneML (reference: /root/reference).
+
+Layer map (SURVEY.md §1 -> here):
+  L0  Breeze/netlib BLAS        -> XLA on the MXU (jnp / lax)
+  L0' C++ JNI featurizers       -> Pallas/XLA kernels (ops.sift, ops.fisher, solvers.gmm)
+  L1  Spark RDD substrate       -> sharded jax.Array over a device Mesh (parallel.mesh)
+  L1' ml-matrix solvers         -> solvers.normal_equations / solvers.block
+  L2  Pipeline DSL              -> core.pipeline (Transformer/Estimator algebra)
+  L3  Operator nodes            -> ops.*
+  L4  Loaders                   -> loaders.* (+ native C++ decode)
+  L4' Evaluation                -> evaluation.*
+  L5  Application pipelines     -> workloads.*
+  L6  CLI launchers             -> python -m keystone_tpu.workloads.<name>
+"""
+
+from .core.pipeline import (
+    Cacher,
+    ChainedEstimator,
+    ChainedLabelEstimator,
+    Estimator,
+    FunctionNode,
+    FunctionTransformer,
+    Identity,
+    LabelEstimator,
+    Pipeline,
+    Transformer,
+    transformer,
+)
+from .parallel.mesh import DistContext, make_mesh, use_mesh
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Cacher",
+    "ChainedEstimator",
+    "ChainedLabelEstimator",
+    "DistContext",
+    "Estimator",
+    "FunctionNode",
+    "FunctionTransformer",
+    "Identity",
+    "LabelEstimator",
+    "Pipeline",
+    "Transformer",
+    "make_mesh",
+    "transformer",
+    "use_mesh",
+]

@@ -1,0 +1,66 @@
+"""Logging trait analog (reference src/main/scala/pipelines/Logging.scala:8-67).
+
+Python stdlib logging with the same convenience surface, plus a wall-clock
+stage timer (the reference's ``"Pipeline took N s"`` lines,
+MnistRandomFFT.scala:34,86-87) and ``jax.named_scope`` tagging so stages show
+up in the JAX profiler — the Spark-UI ``RDD.setName`` analog.
+
+As a library we never touch the root logger; workload entry points call
+:func:`configure_logging` to get console output.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+import jax
+
+_ROOT = logging.getLogger("keystone_tpu")
+_ROOT.addHandler(logging.NullHandler())
+
+
+def configure_logging(level: int = logging.INFO) -> None:
+    """Attach a console handler to the keystone_tpu logger tree.
+    Called by workload CLIs (never on import)."""
+    if any(not isinstance(h, logging.NullHandler) for h in _ROOT.handlers):
+        _ROOT.setLevel(level)
+        return
+    handler = logging.StreamHandler()
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+    )
+    _ROOT.addHandler(handler)
+    _ROOT.setLevel(level)
+
+
+class Logging:
+    """Mixin giving ``log_info`` etc. on a per-class logger under the
+    keystone_tpu hierarchy."""
+
+    @property
+    def logger(self) -> logging.Logger:
+        return logging.getLogger(f"keystone_tpu.{type(self).__name__}")
+
+    def log_debug(self, msg, *args):
+        self.logger.debug(msg, *args)
+
+    def log_info(self, msg, *args):
+        self.logger.info(msg, *args)
+
+    def log_warning(self, msg, *args):
+        self.logger.warning(msg, *args)
+
+    def log_error(self, msg, *args):
+        self.logger.error(msg, *args)
+
+
+@contextlib.contextmanager
+def stage_timer(name: str, logger: logging.Logger | None = None):
+    """Time a pipeline stage and tag it for the profiler."""
+    logger = logger or _ROOT
+    t0 = time.perf_counter()
+    with jax.named_scope(name):
+        yield
+    logger.info("%s took %.3f s", name, time.perf_counter() - t0)

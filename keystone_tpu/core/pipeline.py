@@ -1,0 +1,268 @@
+"""Pipeline DSL core — the product surface of the framework.
+
+TPU-native re-design of KeystoneML's pipeline algebra (reference:
+src/main/scala/pipelines/Transformer.scala:16-82, Estimator.scala:12-33,
+LabelEstimator.scala:13-37, FunctionNode.scala:3).
+
+Design stance (differs from the reference deliberately):
+
+* The reference's ``Transformer[A,B]`` carries an item-level ``apply(A): B``
+  and a bulk ``apply(RDD[A]): RDD[B]`` whose default is a lazy per-item map
+  (Transformer.scala:22).  On TPU the *batch* is the primitive: a node's
+  ``__call__`` takes a batch — a ``jax.Array`` with a leading example axis,
+  possibly sharded over the mesh's data axis — and returns a batch.  The
+  item-level form is derived (``apply_item``), the opposite default of the
+  reference, because batched dense compute is what the MXU wants.
+* There is no lazy DAG / scheduler: JAX tracing under ``jax.jit`` *is* the
+  DAG, and XLA is the scheduler.  ``Pipeline`` composition is therefore plain
+  function composition, and a whole pipeline can be jitted as one program.
+* Nodes are pytrees (registered via ``register_node``) so fitted state
+  (weights, means, …) flows through ``jax.jit`` / ``shard_map`` untouched.
+
+The composition algebra — ``then`` / ``then_estimator`` /
+``then_label_estimator`` (reference Transformer.scala:37-67) — is preserved
+verbatim, including the closure semantics of ``thenEstimator``: fitting the
+chained estimator first pushes the data through the upstream transformer.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Generic, Sequence, TypeVar
+
+import jax
+
+A = TypeVar("A")
+B = TypeVar("B")
+C = TypeVar("C")
+L = TypeVar("L")
+
+
+def register_node(cls, data_fields: Sequence[str] = (), meta_fields: Sequence[str] = ()):
+    """Register a node class as a JAX pytree.
+
+    ``data_fields`` are traced leaves (arrays / fitted state); ``meta_fields``
+    are static aux data (shapes, flags).  Nodes with no fields are leaves-free
+    static pytrees.
+    """
+    data_fields = tuple(data_fields)
+    meta_fields = tuple(meta_fields)
+
+    def flatten(obj):
+        return (
+            tuple(getattr(obj, f) for f in data_fields),
+            tuple(getattr(obj, f) for f in meta_fields),
+        )
+
+    def unflatten(meta, data):
+        obj = object.__new__(cls)
+        for f, v in zip(data_fields, data):
+            object.__setattr__(obj, f, v)
+        for f, v in zip(meta_fields, meta):
+            object.__setattr__(obj, f, v)
+        return obj
+
+    jax.tree_util.register_pytree_node(cls, flatten, unflatten)
+    return cls
+
+
+def node(data_fields: Sequence[str] = (), meta_fields: Sequence[str] = ()):
+    """Class decorator form of :func:`register_node`."""
+
+    def deco(cls):
+        return register_node(cls, data_fields, meta_fields)
+
+    return deco
+
+
+class Transformer(Generic[A, B], abc.ABC):
+    """A deterministic, chainable function node over batches.
+
+    Mirrors reference Transformer.scala:16-82.  Subclasses implement
+    ``__call__(batch)``; ``apply_item`` defaults to batch-of-one.
+    """
+
+    @abc.abstractmethod
+    def __call__(self, batch: A) -> B:  # pragma: no cover - interface
+        ...
+
+    # -- item-level view (the reference's primary form, our derived one) ----
+    def apply_item(self, item):
+        out = self(item[None])
+        return out[0]
+
+    # -- composition algebra (reference Transformer.scala:37-67) ------------
+    def then(self, nxt: "Transformer[B, C]") -> "Pipeline[A, C]":
+        return Pipeline([self, nxt])
+
+    def __rshift__(self, nxt):
+        if isinstance(nxt, Transformer):
+            return self.then(nxt)
+        if isinstance(nxt, Estimator):
+            return self.then_estimator(nxt)
+        if isinstance(nxt, LabelEstimator):
+            return self.then_label_estimator(nxt)
+        return NotImplemented
+
+    def then_function(self, fn: Callable[[B], C]) -> "Pipeline[A, C]":
+        return self.then(FunctionTransformer(fn))
+
+    def then_estimator(self, est: "Estimator[B, C]") -> "ChainedEstimator[A, B, C]":
+        return ChainedEstimator(self, est)
+
+    def then_label_estimator(
+        self, est: "LabelEstimator[B, C, L]"
+    ) -> "ChainedLabelEstimator[A, B, C, L]":
+        return ChainedLabelEstimator(self, est)
+
+
+@node(data_fields=(), meta_fields=("fn", "name"))
+class FunctionTransformer(Transformer):
+    """Wrap a plain function as a Transformer (reference Transformer.scala:75-82)."""
+
+    def __init__(self, fn: Callable, name: str | None = None):
+        self.fn = fn
+        self.name = name or getattr(fn, "__name__", "fn")
+
+    def __call__(self, batch):
+        return self.fn(batch)
+
+    def __repr__(self):
+        return f"FunctionTransformer({self.name})"
+
+
+def transformer(fn: Callable) -> FunctionTransformer:
+    """Functional constructor, the reference's ``Transformer(f)`` companion."""
+    return FunctionTransformer(fn)
+
+
+class Pipeline(Transformer):
+    """Composition of transformers; itself a transformer (and a pytree).
+
+    Flattens nested pipelines so ``(a >> b) >> c`` and ``a >> (b >> c)`` are
+    the same object shape.  The whole pipeline is one traced function — wrap
+    with ``jax.jit(pipe)`` for a single fused XLA program.
+    """
+
+    def __init__(self, nodes: Sequence[Transformer]):
+        flat: list[Transformer] = []
+        for n in nodes:
+            if isinstance(n, Pipeline):
+                flat.extend(n.nodes)
+            else:
+                flat.append(n)
+        self.nodes = tuple(flat)
+
+    def __call__(self, batch):
+        for n in self.nodes:
+            batch = n(batch)
+        return batch
+
+    def apply_item(self, item):
+        for n in self.nodes:
+            item = n.apply_item(item)
+        return item
+
+    def __repr__(self):
+        return "Pipeline(" + " >> ".join(repr(n) for n in self.nodes) + ")"
+
+
+jax.tree_util.register_pytree_node(
+    Pipeline,
+    lambda p: (p.nodes, None),
+    lambda _, nodes: Pipeline(list(nodes)),
+)
+
+
+class Estimator(Generic[A, B], abc.ABC):
+    """Unlabeled fit: data -> fitted Transformer (reference Estimator.scala:12-33)."""
+
+    @abc.abstractmethod
+    def fit(self, data: A) -> Transformer[A, B]:  # pragma: no cover - interface
+        ...
+
+
+class LabelEstimator(Generic[A, B, L], abc.ABC):
+    """Labeled fit: (data, labels) -> Transformer (reference LabelEstimator.scala:13-37)."""
+
+    @abc.abstractmethod
+    def fit(self, data: A, labels: L) -> Transformer[A, B]:  # pragma: no cover
+        ...
+
+
+class FunctionEstimator(Estimator):
+    """Functional constructor for estimators (reference Estimator.scala:21-33)."""
+
+    def __init__(self, fn: Callable[[Any], Transformer]):
+        self.fn = fn
+
+    def fit(self, data):
+        return self.fn(data)
+
+
+class ChainedEstimator(Estimator):
+    """``xform then_estimator est``: fitting first maps data through ``xform``
+    and returns ``xform >> est.fit(xform(data))`` (reference Transformer.scala:37-44)."""
+
+    def __init__(self, xform: Transformer, est: Estimator):
+        self.xform = xform
+        self.est = est
+
+    def fit(self, data):
+        fitted = self.est.fit(self.xform(data))
+        return self.xform.then(fitted)
+
+
+class ChainedLabelEstimator(LabelEstimator):
+    """Labeled analog of :class:`ChainedEstimator` (reference Transformer.scala:55-67)."""
+
+    def __init__(self, xform: Transformer, est: LabelEstimator):
+        self.xform = xform
+        self.est = est
+
+    def fit(self, data, labels):
+        fitted = self.est.fit(self.xform(data), labels)
+        return self.xform.then(fitted)
+
+
+class FunctionNode(Generic[A, B]):
+    """A non-item-wise node (reference FunctionNode.scala:3) — e.g. a splitter
+    producing a list of feature blocks.  Just a named callable."""
+
+    def __call__(self, arg: A) -> B:
+        raise NotImplementedError
+
+
+@node(data_fields=(), meta_fields=())
+class Identity(Transformer):
+    """No-op transformer (reference nodes/util/Identity.scala:12-14)."""
+
+    def __call__(self, batch):
+        return batch
+
+    def __repr__(self):
+        return "Identity()"
+
+
+@node(data_fields=(), meta_fields=("name", "sharding"))
+class Cacher(Transformer):
+    """Materialization barrier (reference nodes/util/Cacher.scala:13-23).
+
+    Spark's ``.cache()`` becomes: commit the value to device memory (optionally
+    with an explicit sharding) and block until resident.  Inside ``jit`` it is
+    the identity — XLA manages materialization there.
+    """
+
+    def __init__(self, name: str | None = None, sharding=None):
+        self.name = name
+        self.sharding = sharding
+
+    def __call__(self, batch):
+        if isinstance(batch, jax.core.Tracer):
+            return batch  # no-op under trace; XLA owns buffers
+        if self.sharding is not None:
+            batch = jax.device_put(batch, self.sharding)
+        return jax.block_until_ready(batch)
+
+    def __repr__(self):
+        return f"Cacher({self.name or ''})"

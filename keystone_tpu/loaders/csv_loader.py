@@ -1,0 +1,34 @@
+"""CSV loading (reference src/main/scala/loaders/CsvDataLoader.scala,
+LabeledData.scala).
+
+The reference parallelizes CSV lines into an RDD of DenseVectors; here the
+host loads into numpy and the array is committed row-sharded to the mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class LabeledData:
+    """(labels, data) pair (reference loaders/LabeledData.scala)."""
+
+    labels: np.ndarray
+    data: np.ndarray
+
+    @staticmethod
+    def from_rows(rows: np.ndarray, label_col: int = 0, one_indexed: bool = False):
+        labels = rows[:, label_col].astype(np.int32)
+        if one_indexed:
+            labels = labels - 1
+        data = np.delete(rows, label_col, axis=1)
+        return LabeledData(labels=labels, data=data)
+
+
+def csv_data_loader(path: str, dtype=np.float32) -> np.ndarray:
+    """Load a comma-separated numeric file into [N, d]
+    (reference loaders/CsvDataLoader.scala)."""
+    return np.loadtxt(path, delimiter=",", dtype=dtype, ndmin=2)

@@ -1,0 +1,205 @@
+"""Statistical feature nodes (reference src/main/scala/nodes/stats/).
+
+All nodes operate on batches ``[N, d]``; per-partition ``rowsToMatrix`` gemm
+batching in the reference (e.g. CosineRandomFeatures.scala:24-32) disappears —
+arrays are already dense and HBM-resident, and the matmul hits the MXU
+directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.pipeline import Estimator, Transformer, node
+from ..parallel.mesh import current_mesh
+from ..parallel.collectives import sharded_moments_jit
+
+
+@node(data_fields=("mean", "std"))
+class StandardScalerModel(Transformer):
+    """Subtract column means, optionally divide by column std
+    (reference nodes/stats/StandardScaler.scala:16-35)."""
+
+    def __init__(self, mean, std=None):
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, batch):
+        out = batch - self.mean
+        if self.std is not None:
+            out = out / self.std
+        return out
+
+
+class StandardScaler(Estimator):
+    """Distributed column mean/std via one fused reduction
+    (reference nodes/stats/StandardScaler.scala:39-60: treeAggregate of a
+    MultivariateOnlineSummarizer -> here a single psum of (count, Σx, Σx²)).
+
+    Matches the reference's guards: sample (n-1) variance; any std that is
+    NaN/Inf/<eps becomes 1.0.
+    """
+
+    def __init__(self, normalize_std_dev: bool = True, eps: float = 1e-12):
+        self.normalize_std_dev = normalize_std_dev
+        self.eps = eps
+
+    def fit(self, data, nvalid: int | None = None) -> StandardScalerModel:
+        n = nvalid if nvalid is not None else data.shape[0]
+        cnt, s, sq = sharded_moments_jit(data)
+        cnt = jnp.asarray(n, data.dtype)
+        mean = s / cnt
+        if not self.normalize_std_dev:
+            return StandardScalerModel(mean, None)
+        var = (sq - cnt * mean * mean) / (cnt - 1.0)
+        std = jnp.sqrt(var)
+        bad = jnp.isnan(std) | jnp.isinf(std) | (jnp.abs(std) < self.eps)
+        std = jnp.where(bad, 1.0, std)
+        return StandardScalerModel(mean, std)
+
+
+@node(data_fields=("W", "b"))
+class CosineRandomFeatures(Transformer):
+    """Random Fourier features ``cos(x Wᵀ + b)``
+    (reference nodes/stats/CosineRandomFeatures.scala:18-57).  One [N,d]x[d,D]
+    gemm on the MXU replaces the per-partition batching."""
+
+    def __init__(self, W, b):
+        if b.shape[0] != W.shape[0]:
+            raise ValueError("# rows of W must match size of b")
+        self.W = W
+        self.b = b
+
+    def __call__(self, batch):
+        return jnp.cos(batch @ self.W.T + self.b)
+
+    @staticmethod
+    def create(
+        num_input_features: int,
+        num_output_features: int,
+        gamma: float,
+        key,
+        w_dist: str = "gaussian",
+        dtype=jnp.float32,
+    ) -> "CosineRandomFeatures":
+        """Gaussian (RBF kernel) or Cauchy (Laplacian kernel) W, uniform b
+        (reference CosineRandomFeatures.scala:46-57)."""
+        kw, kb = jax.random.split(key)
+        shape = (num_output_features, num_input_features)
+        if w_dist == "gaussian":
+            W = jax.random.normal(kw, shape, dtype)
+        elif w_dist == "cauchy":
+            W = jax.random.cauchy(kw, shape, dtype)
+        else:
+            raise ValueError(f"unknown w_dist {w_dist!r}")
+        b = jax.random.uniform(kb, (num_output_features,), dtype) * (2.0 * jnp.pi)
+        return CosineRandomFeatures(W * gamma, b)
+
+
+def next_power_of_two(i: int) -> int:
+    return 1 << (i - 1).bit_length()
+
+
+@node(data_fields=(), meta_fields=())
+class PaddedFFT(Transformer):
+    """Zero-pad to the next power of two; return the real part of the first
+    half of the FFT (reference nodes/stats/PaddedFFT.scala:13-21).
+    d -> next_pow2(d)/2."""
+
+    def __call__(self, batch):
+        padded = next_power_of_two(batch.shape[-1])
+        return jnp.fft.rfft(batch, n=padded, axis=-1).real[..., : padded // 2]
+
+
+@node(data_fields=("signs",))
+class RandomSignNode(Transformer):
+    """Elementwise random ±1 mask (reference nodes/stats/RandomSignNode.scala:11-25)."""
+
+    def __init__(self, signs):
+        self.signs = signs
+
+    def __call__(self, batch):
+        return batch * self.signs
+
+    @staticmethod
+    def create(size: int, key, dtype=jnp.float32) -> "RandomSignNode":
+        signs = jax.random.bernoulli(key, 0.5, (size,)).astype(dtype) * 2.0 - 1.0
+        return RandomSignNode(signs)
+
+
+@node(data_fields=(), meta_fields=("max_val", "alpha"))
+class LinearRectifier(Transformer):
+    """``max(maxVal, x - alpha)`` (reference nodes/stats/LinearRectifier.scala:11-16)."""
+
+    def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
+        self.max_val = max_val
+        self.alpha = alpha
+
+    def __call__(self, batch):
+        return jnp.maximum(self.max_val, batch - self.alpha)
+
+
+@node(data_fields=(), meta_fields=())
+class NormalizeRows(Transformer):
+    """L2-normalize each row, norm floored at machine epsilon
+    (reference nodes/stats/NormalizeRows.scala:10-15)."""
+
+    def __call__(self, batch):
+        norm = jnp.linalg.norm(batch, axis=-1, keepdims=True)
+        return batch / jnp.maximum(norm, 2.2e-16)
+
+
+@node(data_fields=(), meta_fields=())
+class SignedHellingerMapper(Transformer):
+    """Signed square-root power normalization ``sign(x)·sqrt(|x|)``
+    (reference nodes/stats/SignedHellingerMapper.scala:12-22).  Applies
+    elementwise, so the batch form doubles as BatchSignedHellingerMapper."""
+
+    def __call__(self, batch):
+        return jnp.sign(batch) * jnp.sqrt(jnp.abs(batch))
+
+
+# Batch alias matching the reference's separate matrix node.
+BatchSignedHellingerMapper = SignedHellingerMapper
+
+
+class Sampler:
+    """``takeSample``-style row sampler (reference nodes/stats/Sampling.scala:25-37)."""
+
+    def __init__(self, size: int, seed: int = 42):
+        self.size = size
+        self.seed = seed
+
+    def __call__(self, data):
+        n = data.shape[0]
+        if n <= self.size:
+            return data
+        idx = jax.random.choice(
+            jax.random.PRNGKey(self.seed), n, (self.size,), replace=False
+        )
+        return jnp.take(data, idx, axis=0)
+
+
+class ColumnSampler:
+    """Sample columns from a batch of descriptor matrices
+    (reference nodes/stats/Sampling.scala:12-22).  Input [N, d, cols] or a
+    list of [d, cols_i]; output [d, num_samples]."""
+
+    def __init__(self, num_samples: int, seed: int = 42):
+        self.num_samples = num_samples
+        self.seed = seed
+
+    def __call__(self, mats):
+        if isinstance(mats, (list, tuple)):
+            cols = jnp.concatenate([m for m in mats], axis=1)
+        else:
+            n, d, c = mats.shape
+            cols = jnp.moveaxis(mats, 1, 0).reshape(d, n * c)
+        total = cols.shape[1]
+        if total <= self.num_samples:
+            return cols
+        idx = jax.random.choice(
+            jax.random.PRNGKey(self.seed), total, (self.num_samples,), replace=False
+        )
+        return jnp.take(cols, idx, axis=1)

@@ -1,0 +1,103 @@
+"""Communication backend: XLA collectives over ICI/DCN.
+
+The reference's entire comm surface is Spark primitives (SURVEY §2.9):
+``treeAggregate``/``treeReduce`` (reference nodes/stats/StandardScaler.scala:46-48,
+nodes/learning/BlockWeightedLeastSquares.scala:186-216), ``broadcast``
+(BlockLinearMapper.scala:51), ``partitionBy`` shuffles
+(BlockWeightedLeastSquares.scala:335-357) and ``collect``.  Here each maps to
+one XLA collective over the ICI fabric:
+
+  treeReduce/treeAggregate  ->  psum            (one fused all-reduce)
+  broadcast                 ->  replication / all_gather
+  partitionBy shuffle       ->  all_to_all / ppermute
+  collect                   ->  device->host transfer of an already-reduced array
+
+These wrappers are thin on purpose — the win is that under ``jit`` with
+sharded inputs XLA already inserts the right collective; the explicit
+``shard_map`` forms below exist for kernels that want manual control (e.g.
+streaming gram accumulation) and for multi-host DCN layouts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from .mesh import DATA_AXIS
+
+
+def psum_gram(x_block, y_block, axis_name: str = DATA_AXIS):
+    """Per-shard gram + cross-shard reduce: the treeReduce replacement.
+
+    Inside ``shard_map``: computes local ``XᵀX`` and ``XᵀY`` on the MXU and
+    all-reduces over the data axis — one ICI collective replaces the
+    reference's multi-hop executor->driver tree
+    (BlockWeightedLeastSquares.scala:186-216).
+    """
+    ata = jax.lax.psum(x_block.T @ x_block, axis_name)
+    atb = jax.lax.psum(x_block.T @ y_block, axis_name)
+    return ata, atb
+
+
+def sharded_gram(mesh, x, y):
+    """``(XᵀX, XᵀY)`` for row-sharded ``x``/``y`` via an explicit shard_map."""
+    fn = shard_map(
+        functools.partial(psum_gram, axis_name=DATA_AXIS),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=(P(None, None), P(None, None)),
+    )
+    return jax.jit(fn)(x, y)
+
+
+def psum_moments(x_block, axis_name: str = DATA_AXIS, nvalid=None):
+    """Sharded (count, sum, sumsq): the MultivariateOnlineSummarizer analog.
+
+    Zero-padded rows contribute zero to the sums; ``nvalid`` (global true row
+    count) overrides the padded count when provided.
+    """
+    cnt = jax.lax.psum(jnp.asarray(x_block.shape[0], x_block.dtype), axis_name)
+    if nvalid is not None:
+        cnt = jnp.asarray(nvalid, x_block.dtype)
+    s = jax.lax.psum(jnp.sum(x_block, axis=0), axis_name)
+    sq = jax.lax.psum(jnp.sum(x_block * x_block, axis=0), axis_name)
+    return cnt, s, sq
+
+
+@jax.jit
+def sharded_moments_jit(x):
+    """(count, Σx, Σx²) over rows.  Under jit with a row-sharded input XLA
+    lowers the sums to local reductions + one psum over ICI — the
+    treeAggregate(MultivariateOnlineSummarizer) replacement
+    (reference nodes/stats/StandardScaler.scala:46-48)."""
+    cnt = jnp.asarray(x.shape[0], x.dtype)
+    s = jnp.sum(x, axis=0)
+    sq = jnp.sum(x * x, axis=0)
+    return cnt, s, sq
+
+
+def all_to_all_rows(mesh, x, axis_name: str = DATA_AXIS):
+    """Reshard rows across the data axis — the partitionBy/shuffle analog.
+
+    Each shard's rows are split into axis_size equal groups and group j is
+    delivered to device j (tiled all_to_all), so row i of the global array
+    lands on device ``(i mod per_shard) // (per_shard / k)`` — a deterministic
+    round-robin redistribution.  Requires per-shard row count divisible by the
+    axis size.
+    """
+
+    def body(xs):
+        return jax.lax.all_to_all(xs, axis_name, 0, 0, tiled=True)
+
+    spec = P(DATA_AXIS, *([None] * (x.ndim - 1)))
+    fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    return jax.jit(fn)(x)
+
+
+def replicate_to(mesh, x):
+    """Broadcast analog: commit an array replicated across the mesh."""
+    return jax.device_put(x, NamedSharding(mesh, P()))

@@ -1,0 +1,126 @@
+"""Device mesh + sharding helpers — the execution substrate.
+
+Replaces the reference's Spark-RDD substrate (SURVEY §1 L1): an RDD partition
+becomes a shard of a ``jax.Array`` over the mesh's ``data`` axis; the feature
+/ model-block dimension (reference nodes/util/VectorSplitter.scala:10-36)
+maps to the ``model`` axis.  All cross-device communication is XLA
+collectives over ICI — there is no driver/executor split; host Python is the
+single controller and device arrays persist in HBM between stages.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(data: int | None = None, model: int = 1, devices=None) -> Mesh:
+    """Build a (data, model) mesh.  ``data=None`` uses all remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data is None:
+        if n % model != 0:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    if data * model > n:
+        raise ValueError(f"mesh {data}x{model} needs {data * model} devices, have {n}")
+    arr = np.array(devices[: data * model]).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(data=1, model=1)
+
+
+_current_mesh: list[Mesh] = []
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Set the ambient mesh used by estimators when sharding inputs."""
+    _current_mesh.append(mesh)
+    try:
+        yield mesh
+    finally:
+        _current_mesh.pop()
+
+
+def current_mesh() -> Mesh | None:
+    return _current_mesh[-1] if _current_mesh else None
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Examples sharded over the data axis; features replicated (the RDD analog)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def model_sharding(mesh: Mesh, axis: int = 1, ndim: int = 2) -> NamedSharding:
+    """Shard a parameter array over the model axis along ``axis``."""
+    spec = [None] * ndim
+    spec[axis] = MODEL_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_rows(x, mesh: Mesh | None = None):
+    """Place a [N, ...] array row-sharded on the mesh's data axis.
+    N must be divisible by the data-axis size; otherwise use
+    :func:`padded_shard_rows`."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        return jax.device_put(x)
+    return jax.device_put(x, row_sharding(mesh))
+
+
+def padded_shard_rows(x, mesh: Mesh | None = None):
+    """Pad N up to a multiple of the data-axis size with zero rows, shard,
+    return (x, nvalid).
+
+    Zero rows contribute nothing to raw sums, but any estimator that
+    *centers* data must be told ``nvalid`` (pad rows become ``-mean`` after
+    centering and would pollute grams) — the solvers' ``fit(..., nvalid=)``
+    parameter masks pad rows back to zero after centering.
+    """
+    mesh = mesh or current_mesh()
+    n = x.shape[0]
+    if mesh is None:
+        return jax.device_put(x), n
+    d = mesh.shape[DATA_AXIS]
+    pad = (-n) % d
+    if pad:
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        x = np.pad(np.asarray(x), widths)
+    return jax.device_put(x, row_sharding(mesh)), n
+
+
+@dataclass(frozen=True)
+class DistContext:
+    """Bundle of mesh + canonical shardings threaded through solvers."""
+
+    mesh: Mesh
+
+    @property
+    def rows(self) -> NamedSharding:
+        return row_sharding(self.mesh)
+
+    @property
+    def repl(self) -> NamedSharding:
+        return replicated(self.mesh)
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    @property
+    def n_model(self) -> int:
+        return self.mesh.shape[MODEL_AXIS]

@@ -1,0 +1,77 @@
+"""Linear models + least-squares estimators
+(reference src/main/scala/nodes/learning/LinearMapper.scala:18-93)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.pipeline import LabelEstimator, Transformer, node
+from ..ops.stats import StandardScaler, StandardScalerModel
+from .normal_equations import solve_least_squares
+
+
+@node(data_fields=("x", "b", "feature_scaler"))
+class LinearMapper(Transformer):
+    """``out = (scale(in)) @ x + b`` (reference LinearMapper.scala:18-56).
+
+    ``x`` is [d, k]; the reference stores the same matrix and computes
+    ``x.t * in`` per item / ``rowsToMatrix(rows) * x`` per partition — here a
+    single [N,d]x[d,k] MXU gemm.
+    """
+
+    def __init__(self, x, b=None, feature_scaler: StandardScalerModel | None = None):
+        self.x = x
+        self.b = b
+        self.feature_scaler = feature_scaler
+
+    def __call__(self, batch):
+        if self.feature_scaler is not None:
+            batch = self.feature_scaler(batch)
+        out = batch @ self.x
+        if self.b is not None:
+            out = out + self.b
+        return out
+
+
+class LinearMapEstimator(LabelEstimator):
+    """OLS / ridge via sharded normal equations
+    (reference LinearMapper.scala:63-93): mean-center features and labels
+    (mean-only StandardScaler), solve, intercept = label mean."""
+
+    def __init__(self, lam: float | None = None):
+        self.lam = lam
+
+    def fit(self, features, labels, nvalid: int | None = None) -> LinearMapper:
+        """``nvalid``: true global row count when ``features``/``labels`` were
+        zero-padded for sharding (see parallel.mesh.padded_shard_rows) —
+        centering turns pad rows into ``-mean``, so they are masked back to
+        zero before the gram."""
+        feature_scaler = StandardScaler(normalize_std_dev=False).fit(
+            features, nvalid=nvalid
+        )
+        label_scaler = StandardScaler(normalize_std_dev=False).fit(
+            labels, nvalid=nvalid
+        )
+        a = feature_scaler(features)
+        b = label_scaler(labels)
+        if nvalid is not None and nvalid < features.shape[0]:
+            mask = (jnp.arange(features.shape[0]) < nvalid).astype(a.dtype)[:, None]
+            a = a * mask
+            b = b * mask
+        x = solve_least_squares(a, b, float(self.lam or 0.0))
+        return LinearMapper(x, label_scaler.mean, feature_scaler)
+
+
+@node(data_fields=("weights", "intercept"))
+class LeastSquaresModel(Transformer):
+    """Bare ``X @ W + b`` head used by generic model application."""
+
+    def __init__(self, weights, intercept=None):
+        self.weights = weights
+        self.intercept = intercept
+
+    def __call__(self, batch):
+        out = batch @ self.weights
+        if self.intercept is not None:
+            out = out + self.intercept
+        return out

@@ -1,0 +1,113 @@
+"""Sharded normal-equations least squares — re-owns the external ml-matrix
+library (`edu.berkeley.cs.amplab.mlmatrix.NormalEquations`, SURVEY §2.2: the
+jar imported at reference nodes/learning/BlockLinearMapper.scala:4).
+
+The reference accumulates per-partition ``AᵀA``/``Aᵀb`` grams with a
+configurable tree-reduce to the driver, then solves there.  Here: local grams
+on each data shard hit the MXU, one psum over ICI reduces them, and the
+λ-shifted Cholesky solve runs replicated on-device.  No driver round-trip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+
+@jax.jit
+def gram(a, b):
+    """(AᵀA, AᵀB).  With row-sharded inputs under jit XLA emits
+    local-gram + all-reduce (the treeReduce replacement)."""
+    return a.T @ a, a.T @ b
+
+
+@functools.partial(jax.jit, static_argnames=())
+def solve_gram_l2(ata, atb, lam):
+    """Solve ``(AᵀA + λI) X = AᵀB`` via Cholesky."""
+    d = ata.shape[0]
+    reg = ata + lam * jnp.eye(d, dtype=ata.dtype)
+    c, low = jsl.cho_factor(reg)
+    return jsl.cho_solve((c, low), atb)
+
+
+def solve_least_squares(a, b, lam: float = 0.0):
+    """One-shot (regularized) least squares ``min ‖AX - B‖² + λ‖X‖²``."""
+    ata, atb = gram(a, b)
+    return solve_gram_l2(ata, atb, jnp.asarray(lam, ata.dtype))
+
+
+class NormalEquations:
+    """Class-shaped facade matching the ml-matrix API surface."""
+
+    def solve_least_squares(self, a, b):
+        return solve_least_squares(a, b, 0.0)
+
+    def solve_least_squares_with_l2(self, a, b, lam):
+        return solve_least_squares(a, b, lam)
+
+
+@jax.jit
+def _bcd_residual_init(blocks_t, models_t, labels_t):
+    r = labels_t
+    for blk, m in zip(blocks_t, models_t):
+        r = r - blk @ m
+    return r
+
+
+@jax.jit
+def _bcd_block_update(blk, ata, m_old, r, lam_):
+    r_i = r + blk @ m_old
+    atb = blk.T @ r_i
+    m_new = solve_gram_l2(ata, atb, lam_)
+    r_new = r_i - blk @ m_new
+    return m_new, r_new
+
+
+def bcd_least_squares_l2(
+    blocks,
+    labels,
+    lam: float,
+    num_iter: int,
+    models_init=None,
+):
+    """Block coordinate descent for ``min ‖Σ_i A_i X_i - B‖² + λΣ‖X_i‖²`` —
+    re-owns ml-matrix ``BlockCoordinateDescent.solveLeastSquaresWithL2``
+    (SURVEY §2.2, called at reference BlockLinearMapper.scala:196-198).
+
+    Per epoch, per block i:  solve
+    ``(A_iᵀA_i + λI) X_i' = A_iᵀ (R + A_i X_i)`` where ``R = B - Σ_j A_j X_j``
+    is the running residual, then update R.  Block grams are computed once and
+    reused across epochs (they are constant), so epochs>1 cost only the
+    ``A_i X_i`` matmuls and the solve.
+
+    blocks: list of [N, d_i] arrays (row-sharded ok);  labels: [N, k].
+    Returns list of [d_i, k] model blocks.
+    """
+    lam = jnp.asarray(lam, labels.dtype)
+    nblocks = len(blocks)
+    if models_init is None:
+        models = [
+            jnp.zeros((blk.shape[1], labels.shape[1]), labels.dtype) for blk in blocks
+        ]
+    else:
+        models = list(models_init)
+
+    if nblocks == 1 and models_init is None:
+        # Degenerate case = plain normal equations; skip the residual machinery.
+        return [solve_least_squares(blocks[0], labels, lam)]
+
+    grams = []
+    for blk in blocks:
+        ata, _ = gram(blk, labels[:, :0])
+        grams.append(ata)
+
+    residual = _bcd_residual_init(tuple(blocks), tuple(models), labels)
+    for _ in range(num_iter):
+        for i in range(nblocks):
+            models[i], residual = _bcd_block_update(
+                blocks[i], grams[i], models[i], residual, lam
+            )
+    return models

@@ -1,0 +1,42 @@
+"""Numeric test/eval helpers (reference utils/Stats.scala:25-123)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def about_eq(a, b, thresh: float = 1e-8) -> bool:
+    """Tolerance comparison for scalars/vectors/matrices
+    (reference utils/Stats.scala:25-66: elementwise |a-b| < thresh)."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    return bool(np.all(np.abs(a - b) < thresh))
+
+
+def classification_error(predicted, actual, k: int = 1) -> float:
+    """Fraction of examples whose true label is NOT in the top-k prediction
+    (reference utils/Stats.scala:76-102).  ``predicted`` is [N, k] of label
+    indices (or [N] for k=1); ``actual`` is [N] int labels."""
+    predicted = np.asarray(predicted)
+    actual = np.asarray(actual)
+    if predicted.ndim == 1:
+        predicted = predicted[:, None]
+    hits = (predicted[:, :k] == actual[:, None]).any(axis=1)
+    return float(1.0 - hits.mean())
+
+
+def get_err_percent(predicted, actual, k: int = 1) -> float:
+    return 100.0 * classification_error(predicted, actual, k)
+
+
+def normalize_rows(mat, alpha: float = 1.0):
+    """Row-normalize to zero mean / unit-ish variance with additive smoothing
+    (reference utils/Stats.scala:105-123): per row,
+    ``(x - mean) / sqrt(var + alpha)``."""
+    mat = jnp.asarray(mat)
+    mean = jnp.mean(mat, axis=1, keepdims=True)
+    var = jnp.var(mat, axis=1, keepdims=True)
+    return (mat - mean) / jnp.sqrt(var + alpha)

@@ -1,0 +1,145 @@
+"""MnistRandomFFT — the first end-to-end workload
+(reference src/main/scala/pipelines/images/mnist/MnistRandomFFT.scala:17-127).
+
+Pipeline: CSV load -> per-FFT-batch [RandomSign -> PaddedFFT -> LinearRectifier]
+-> ZipVectors -> BlockLeastSquares(blockSize, 1 iter, λ) -> MaxClassifier ->
+MulticlassClassifierEvaluator.  784-pixel inputs give 512 PaddedFFT features
+per FFT, so blockSize/512 FFTs land in each solver block, exactly as the
+reference computes fftsPerBatch/numFFTBatches (:31-33).
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from ..core.logging import Logging, configure_logging
+from ..core.pipeline import Pipeline
+from ..evaluation.multiclass import MulticlassClassifierEvaluator
+from ..loaders.csv_loader import LabeledData, csv_data_loader
+from ..ops.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from ..ops.util import ClassLabelIndicatorsFromIntLabels, MaxClassifier, ZipVectors
+from ..solvers.block import BlockLeastSquaresEstimator
+
+
+@dataclass
+class MnistRandomFFTConfig:
+    """Flag-compatible with the reference scopt config (:94-101)."""
+
+    train_location: str = ""
+    test_location: str = ""
+    num_ffts: int = 200
+    block_size: int = 2048
+    lam: float | None = None
+    seed: int = 0
+    mnist_image_size: int = 784
+    num_classes: int = 10
+
+
+def build_featurizer_batches(conf: MnistRandomFFTConfig):
+    """The per-batch featurizers (:44-48): blockSize/512 FFT chains per batch."""
+    ffts_per_batch = conf.block_size // 512
+    num_fft_batches = math.ceil(conf.num_ffts / ffts_per_batch)
+    key = jax.random.PRNGKey(conf.seed)
+    batches = []
+    for _ in range(num_fft_batches):
+        chain = []
+        for _ in range(ffts_per_batch):
+            key, sub = jax.random.split(key)
+            chain.append(
+                Pipeline(
+                    [
+                        RandomSignNode.create(conf.mnist_image_size, sub),
+                        PaddedFFT(),
+                        LinearRectifier(0.0),
+                    ]
+                )
+            )
+        batches.append(chain)
+    return batches
+
+
+def run(conf: MnistRandomFFTConfig, train: LabeledData, test: LabeledData) -> dict:
+    configure_logging()
+    log = _Log()
+    t0 = time.perf_counter()
+
+    labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
+    batch_featurizer = build_featurizer_batches(conf)
+
+    train_data = jnp.asarray(train.data)
+    training_batches = [
+        ZipVectors.apply([chain(train_data) for chain in chains])
+        for chains in batch_featurizer
+    ]
+
+    model = BlockLeastSquaresEstimator(
+        conf.block_size, 1, conf.lam or 0.0
+    ).fit(training_batches, labels)
+
+    test_data = jnp.asarray(test.data)
+    test_batches = [
+        ZipVectors.apply([chain(test_data) for chain in chains])
+        for chains in batch_featurizer
+    ]
+
+    results: dict = {}
+
+    def train_eval(pred):
+        predicted = MaxClassifier()(pred)
+        ev = MulticlassClassifierEvaluator(predicted, train.labels, conf.num_classes)
+        results["train_error"] = 100.0 * ev.total_error
+        log.log_info("Train Error is %s%%", results["train_error"])
+
+    def test_eval(pred):
+        predicted = MaxClassifier()(pred)
+        ev = MulticlassClassifierEvaluator(predicted, test.labels, conf.num_classes)
+        results["test_error"] = 100.0 * ev.total_error
+        log.log_info("TEST Error is %s%%", results["test_error"])
+
+    # Streaming evaluation after each block, as the reference does (:70-86);
+    # the last invocation sees the full-model prediction.
+    model.apply_and_evaluate(training_batches, train_eval)
+    model.apply_and_evaluate(test_batches, test_eval)
+
+    results["seconds"] = time.perf_counter() - t0
+    log.log_info("Pipeline took %.3f s", results["seconds"])
+    return results
+
+
+class _Log(Logging):
+    pass
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("MnistRandomFFT")
+    p.add_argument("--trainLocation", required=True)
+    p.add_argument("--testLocation", required=True)
+    p.add_argument("--numFFTs", type=int, default=200)
+    p.add_argument("--blockSize", type=int, default=2048)
+    p.add_argument("--lambda", dest="lam", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    a = p.parse_args(argv)
+    if a.blockSize % 512 != 0:
+        p.error("--blockSize must be divisible by 512")
+    conf = MnistRandomFFTConfig(
+        train_location=a.trainLocation,
+        test_location=a.testLocation,
+        num_ffts=a.numFFTs,
+        block_size=a.blockSize,
+        lam=a.lam,
+        seed=a.seed,
+    )
+    # Labels in the files are 1-indexed (reference :40-42)
+    train = LabeledData.from_rows(csv_data_loader(conf.train_location), one_indexed=True)
+    test = LabeledData.from_rows(csv_data_loader(conf.test_location), one_indexed=True)
+    return run(conf, train, test)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Test harness: distributed-without-a-cluster.
+
+The reference boots a local[k] SparkContext per suite
+(reference src/test/scala/pipelines/LocalSparkContext.scala:9-43); here the
+analog is a virtual 8-device CPU platform so every mesh/collective path is
+exercised without TPU hardware.  Must set flags before jax initializes.
+"""
+
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# Some environments pin jax_platforms from a sitecustomize hook (e.g. a TPU
+# plugin registering itself and setting "axon,cpu"); the env var alone is not
+# enough — force the CPU platform before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from keystone_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    """8-way data-parallel mesh (the local[8] analog)."""
+    return make_mesh(data=8, model=1)
+
+
+@pytest.fixture(scope="session")
+def mesh42(devices):
+    """4x2 data-by-model mesh for mixed-parallel tests."""
+    return make_mesh(data=4, model=2)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,55 @@
+"""Evaluator tests (reference src/test/scala/evaluation/*Suite.scala)."""
+
+import numpy as np
+
+from keystone_tpu.evaluation.multiclass import (
+    BinaryClassifierEvaluator,
+    MulticlassClassifierEvaluator,
+)
+
+
+def test_multiclass_perfect():
+    actual = [0, 1, 2, 1, 0]
+    m = MulticlassClassifierEvaluator(actual, actual, 3)
+    assert m.total_accuracy == 1.0
+    assert m.total_error == 0.0
+    assert m.macro_precision == 1.0
+
+
+def test_multiclass_confusion_and_metrics():
+    actual = [0, 0, 1, 1, 2, 2]
+    pred = [0, 1, 1, 1, 2, 0]
+    m = MulticlassClassifierEvaluator(pred, actual, 3)
+    cm = m.confusion_matrix  # rows=actual, cols=pred
+    assert cm[0, 0] == 1 and cm[0, 1] == 1
+    assert cm[1, 1] == 2
+    assert cm[2, 2] == 1 and cm[2, 0] == 1
+    assert abs(m.total_error - 2.0 / 6.0) < 1e-9
+    assert abs(m.total_accuracy - 4.0 / 6.0) < 1e-9
+    # class-1 precision: predicted 1 three times, 2 correct
+    assert abs(m.class_metrics[1].precision - 2.0 / 3.0) < 1e-9
+    s = m.summary(["a", "b", "c"])
+    assert "Total Accuracy" in s and "Macro F1" in s
+
+
+def test_binary_metrics():
+    pred = [True, True, False, False, True]
+    act = [True, False, False, True, True]
+    b = BinaryClassifierEvaluator(pred, act)
+    assert b.tp == 2 and b.fp == 1 and b.tn == 1 and b.fn == 1
+    assert abs(b.accuracy - 3.0 / 5.0) < 1e-9
+    assert abs(b.precision - 2.0 / 3.0) < 1e-9
+    assert abs(b.recall - 2.0 / 3.0) < 1e-9
+    assert abs(b.f_score() - 2.0 / 3.0) < 1e-9
+
+
+def test_multiclass_matches_sklearn_style_micro(rng):
+    n, k = 500, 7
+    actual = rng.integers(0, k, n)
+    pred = actual.copy()
+    flip = rng.random(n) < 0.3
+    pred[flip] = (pred[flip] + 1 + rng.integers(0, k - 1, flip.sum())) % k
+    m = MulticlassClassifierEvaluator(pred, actual, k)
+    acc = (pred == actual).mean()
+    assert abs(m.total_accuracy - acc) < 1e-9
+    assert abs(m.total_error - (1 - acc)) < 1e-9

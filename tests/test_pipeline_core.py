@@ -1,0 +1,87 @@
+"""Core DSL tests (reference behavior: pipelines/Transformer.scala,
+PipelineSuite-style composition checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu import (
+    Estimator,
+    FunctionTransformer,
+    Identity,
+    Pipeline,
+    transformer,
+)
+from keystone_tpu.core.pipeline import FunctionEstimator
+
+
+def test_transformer_call_and_item():
+    t = transformer(lambda x: x * 2.0)
+    batch = jnp.arange(6.0).reshape(3, 2)
+    assert np.allclose(t(batch), batch * 2)
+    assert np.allclose(t.apply_item(jnp.array([1.0, 2.0])), [2.0, 4.0])
+
+
+def test_then_composition_and_flattening():
+    a = transformer(lambda x: x + 1.0)
+    b = transformer(lambda x: x * 3.0)
+    c = transformer(lambda x: x - 2.0)
+    p1 = (a >> b) >> c
+    p2 = a >> (b >> c)
+    assert len(p1.nodes) == 3 and len(p2.nodes) == 3
+    x = jnp.ones((2, 2))
+    assert np.allclose(p1(x), p2(x))
+    assert np.allclose(p1(x), (1.0 + 1.0) * 3.0 - 2.0)
+
+
+def test_pipeline_is_jittable_pytree():
+    a = transformer(lambda x: x + 1.0)
+    b = transformer(lambda x: x * 3.0)
+    pipe = a >> b
+    jitted = jax.jit(lambda p, x: p(x))
+    out = jitted(pipe, jnp.ones((2, 2)))
+    assert np.allclose(out, 6.0)
+
+
+def test_then_estimator_closure_semantics():
+    """thenEstimator fits on *transformed* data (Transformer.scala:37-44)."""
+    pre = transformer(lambda x: x * 10.0)
+
+    seen = {}
+
+    def fit_fn(data):
+        seen["data"] = np.asarray(data)
+        mean = jnp.mean(data, axis=0)
+        return transformer(lambda x: x - mean)
+
+    est = FunctionEstimator(fit_fn)
+    chained = pre.then_estimator(est)
+    data = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+    fitted = chained.fit(data)
+    assert np.allclose(seen["data"], np.asarray(data) * 10.0)
+    out = fitted(data)
+    assert np.allclose(out, data * 10.0 - np.asarray(data).mean(0) * 10.0)
+
+
+def test_then_label_estimator():
+    from keystone_tpu.core.pipeline import ChainedLabelEstimator, LabelEstimator
+
+    class Thresh(LabelEstimator):
+        def fit(self, data, labels):
+            shift = jnp.mean(labels)
+            return transformer(lambda x: x + shift)
+
+    pre = transformer(lambda x: x * 2.0)
+    fitted = pre.then_label_estimator(Thresh()).fit(
+        jnp.ones((3, 2)), jnp.array([1.0, 2.0, 3.0])
+    )
+    assert np.allclose(fitted(jnp.ones((1, 2))), 2.0 + 2.0)
+
+
+def test_identity_and_repr():
+    i = Identity()
+    x = jnp.ones((2, 3))
+    assert i(x) is x
+    assert "Identity" in repr(i)
+    p = Pipeline([i, FunctionTransformer(lambda y: y, name="f")])
+    assert "f" in repr(p)

@@ -1,0 +1,114 @@
+"""Stats node tests (reference src/test/scala/nodes/stats/*Suite.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.stats import (
+    CosineRandomFeatures,
+    LinearRectifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    SignedHellingerMapper,
+    StandardScaler,
+    next_power_of_two,
+)
+from keystone_tpu.parallel.mesh import padded_shard_rows, use_mesh
+from keystone_tpu.utils.stats import about_eq
+
+
+def test_standard_scaler_matches_numpy(rng):
+    x = jnp.asarray(rng.normal(2.0, 3.0, (100, 7)).astype(np.float32))
+    model = StandardScaler().fit(x)
+    assert about_eq(model.mean, np.asarray(x).mean(0), 1e-4)
+    assert about_eq(model.std, np.asarray(x).std(0, ddof=1), 1e-3)
+    out = model(x)
+    assert about_eq(np.asarray(out).mean(0), np.zeros(7), 1e-4)
+    assert about_eq(np.asarray(out).std(0, ddof=1), np.ones(7), 1e-3)
+
+
+def test_standard_scaler_zero_variance_guard(rng):
+    x = jnp.asarray(np.full((10, 3), 5.0, np.float32))
+    model = StandardScaler().fit(x)
+    assert about_eq(model.std, np.ones(3), 1e-6)  # eps guard -> 1.0
+
+
+def test_standard_scaler_mean_only(rng):
+    x = jnp.asarray(rng.normal(size=(50, 4)).astype(np.float32))
+    model = StandardScaler(normalize_std_dev=False).fit(x)
+    assert model.std is None
+
+
+def test_standard_scaler_sharded_equals_local(mesh8, rng):
+    """Distributed mean/var over the 8-device mesh == local computation
+    (the treeAggregate parity check, StandardScaler.scala:46-48)."""
+    x = rng.normal(size=(101, 5)).astype(np.float32)  # non-divisible N
+    xs, n = padded_shard_rows(jnp.asarray(x), mesh8)
+    model = StandardScaler().fit(xs, nvalid=n)
+    assert about_eq(model.mean, x.mean(0), 1e-4)
+    assert about_eq(model.std, x.std(0, ddof=1), 1e-3)
+
+
+def test_cosine_random_features_mapping(rng):
+    """Exact cos mapping (reference CosineRandomFeaturesSuite.scala:16-34)."""
+    W = jnp.asarray(rng.normal(size=(8, 5)).astype(np.float32))
+    b = jnp.asarray(rng.uniform(size=(8,)).astype(np.float32))
+    crf = CosineRandomFeatures(W, b)
+    x = jnp.asarray(rng.normal(size=(10, 5)).astype(np.float32))
+    expected = np.cos(np.asarray(x) @ np.asarray(W).T + np.asarray(b))
+    assert about_eq(crf(x), expected, 1e-5)
+
+
+def test_cosine_random_features_distribution():
+    crf = CosineRandomFeatures.create(400, 1000, 0.5, jax.random.PRNGKey(0))
+    w = np.asarray(crf.W)
+    assert abs(w.mean()) < 0.01
+    assert abs(w.std() - 0.5) < 0.01  # gamma-scaled gaussian
+    bvals = np.asarray(crf.b)
+    assert 0 <= bvals.min() and bvals.max() <= 2 * np.pi
+
+
+def test_padded_fft_semantics():
+    """d=784 -> pad 1024 -> 512 real features (PaddedFFT.scala:13-21)."""
+    assert next_power_of_two(784) == 1024
+    x = np.random.default_rng(0).normal(size=(3, 784)).astype(np.float32)
+    out = PaddedFFT()(jnp.asarray(x))
+    assert out.shape == (3, 512)
+    padded = np.zeros((3, 1024))
+    padded[:, :784] = x
+    expected = np.fft.fft(padded, axis=1).real[:, :512]
+    assert about_eq(out, expected, 1e-2)
+
+
+def test_padded_fft_exact_power_of_two():
+    x = np.ones((2, 8), np.float32)
+    out = PaddedFFT()(jnp.asarray(x))
+    assert out.shape == (2, 4)
+
+
+def test_random_sign_node():
+    node = RandomSignNode.create(1000, jax.random.PRNGKey(3))
+    s = np.asarray(node.signs)
+    assert set(np.unique(s)) == {-1.0, 1.0}
+    assert abs(s.mean()) < 0.1
+    x = jnp.ones((2, 1000))
+    assert about_eq(node(x), np.broadcast_to(s, (2, 1000)), 1e-6)
+
+
+def test_linear_rectifier():
+    x = jnp.asarray([[-1.0, 0.5, 2.0]])
+    assert about_eq(LinearRectifier(0.0, 0.0)(x), [[0.0, 0.5, 2.0]], 1e-6)
+    assert about_eq(LinearRectifier(0.0, 1.0)(x), [[0.0, 0.0, 1.0]], 1e-6)
+
+
+def test_normalize_rows():
+    x = jnp.asarray([[3.0, 4.0], [0.0, 0.0]])
+    out = np.asarray(NormalizeRows()(x))
+    assert about_eq(out[0], [0.6, 0.8], 1e-6)
+    assert np.all(np.isfinite(out[1]))  # eps floor, no NaN
+
+
+def test_signed_hellinger():
+    x = jnp.asarray([[4.0, -9.0, 0.0]])
+    assert about_eq(SignedHellingerMapper()(x), [[2.0, -3.0, 0.0]], 1e-6)
