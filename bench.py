@@ -1,0 +1,87 @@
+"""Benchmark driver — prints ONE JSON line.
+
+Provisional benchmark: MnistRandomFFT canonical config (--numFFTs 4
+--blockSize 2048, reference README.md:14-24 / BASELINE.json configs) on
+synthetic MNIST-shaped data; metric is end-to-end featurize+predict
+images/sec/chip.  Will be upgraded to RandomPatchCifar (the north-star
+config) once the image stack lands.
+
+The reference publishes no throughput numbers (BASELINE.md), so
+``vs_baseline`` is reported as 1.0 by convention: the baseline is accuracy
+parity, and any measured throughput is the number to beat in later rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.core.pipeline import Pipeline
+from keystone_tpu.ops.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels, ZipVectors
+from keystone_tpu.solvers.block import BlockLeastSquaresEstimator
+
+
+def main():
+    image_size = 784
+    num_ffts = 4
+    block_size = 2048
+    num_classes = 10
+    n_train = 8192
+    n_bench = 16384
+    iters = 20
+
+    key = jax.random.PRNGKey(0)
+    chains = []
+    for _ in range(num_ffts):
+        key, sub = jax.random.split(key)
+        chains.append(
+            Pipeline(
+                [
+                    RandomSignNode.create(image_size, sub),
+                    PaddedFFT(),
+                    LinearRectifier(0.0),
+                ]
+            )
+        )
+
+    kx, ky, kb = jax.random.split(key, 3)
+    train_x = jax.random.uniform(kx, (n_train, image_size), jnp.float32)
+    train_y = jax.random.randint(ky, (n_train,), 0, num_classes)
+    labels = ClassLabelIndicatorsFromIntLabels(num_classes)(train_y)
+
+    feats = ZipVectors.apply([chain(train_x) for chain in chains])
+    model = BlockLeastSquaresEstimator(block_size, 1, 1e-3).fit(feats, labels)
+
+    @jax.jit
+    def predict(batch):
+        f = ZipVectors.apply([chain(batch) for chain in chains])
+        return jnp.argmax(model(f), axis=-1)
+
+    bench_x = jax.random.uniform(kb, (n_bench, image_size), jnp.float32)
+    predict(bench_x).block_until_ready()  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = predict(bench_x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    n_chips = len(jax.devices())
+    images_per_sec_per_chip = (n_bench * iters) / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_random_fft_featurize_predict",
+                "value": round(images_per_sec_per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -167,7 +167,7 @@ def confusion_matrix(predictions, actuals, num_classes: int):
     actuals = jnp.asarray(actuals).astype(jnp.int32)
     flat = actuals * num_classes + predictions
     counts = jnp.bincount(flat, length=num_classes * num_classes)
-    return counts.reshape(num_classes, num_classes).astype(jnp.float64 if jnp.zeros(0).dtype == jnp.float64 else jnp.float32)
+    return counts.reshape(num_classes, num_classes)
 
 
 class MulticlassClassifierEvaluator:

@@ -12,7 +12,6 @@ import jax
 import jax.numpy as jnp
 
 from ..core.pipeline import Estimator, Transformer, node
-from ..parallel.mesh import current_mesh
 from ..parallel.collectives import sharded_moments_jit
 
 
@@ -47,8 +46,8 @@ class StandardScaler(Estimator):
 
     def fit(self, data, nvalid: int | None = None) -> StandardScalerModel:
         n = nvalid if nvalid is not None else data.shape[0]
-        cnt, s, sq = sharded_moments_jit(data)
-        cnt = jnp.asarray(n, data.dtype)
+        _, s, sq = sharded_moments_jit(data)
+        cnt = jnp.asarray(n, data.dtype)  # true row count (excludes pad rows)
         mean = s / cnt
         if not self.normalize_std_dev:
             return StandardScalerModel(mean, None)

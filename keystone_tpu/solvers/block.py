@@ -48,6 +48,10 @@ class BlockLinearMapper(Transformer):
 
     def apply_blocks(self, blocks: Sequence):
         """Apply to pre-split feature blocks (reference :47-74)."""
+        if len(blocks) != len(self.xs):
+            raise ValueError(
+                f"{len(blocks)} feature blocks vs {len(self.xs)} model blocks"
+            )
         out = None
         for blk, x, scaler in zip(blocks, self.xs, self.feature_scalers):
             part = scaler(blk) @ x
@@ -72,6 +76,10 @@ class BlockLinearMapper(Transformer):
             if isinstance(batch_or_blocks, (list, tuple))
             else self.vector_splitter(batch_or_blocks)
         )
+        if len(blocks) != len(self.xs):
+            raise ValueError(
+                f"{len(blocks)} feature blocks vs {len(self.xs)} model blocks"
+            )
         running = None
         for blk, x, scaler in zip(blocks, self.xs, self.feature_scalers):
             part = scaler(blk) @ x

@@ -38,5 +38,5 @@ def normalize_rows(mat, alpha: float = 1.0):
     ``(x - mean) / sqrt(var + alpha)``."""
     mat = jnp.asarray(mat)
     mean = jnp.mean(mat, axis=1, keepdims=True)
-    var = jnp.var(mat, axis=1, keepdims=True)
+    var = jnp.var(mat, axis=1, keepdims=True, ddof=1)  # sample variance (n-1)
     return (mat - mean) / jnp.sqrt(var + alpha)

@@ -125,8 +125,8 @@ def main(argv=None):
     p.add_argument("--lambda", dest="lam", type=float, default=None)
     p.add_argument("--seed", type=int, default=0)
     a = p.parse_args(argv)
-    if a.blockSize % 512 != 0:
-        p.error("--blockSize must be divisible by 512")
+    if a.blockSize <= 0 or a.blockSize % 512 != 0:
+        p.error("--blockSize must be a positive multiple of 512")
     conf = MnistRandomFFTConfig(
         train_location=a.trainLocation,
         test_location=a.testLocation,
