@@ -1,0 +1,53 @@
+"""CIFAR-10 binary loader (reference src/main/scala/loaders/CifarLoader.scala:13-50).
+
+Record format: 1 label byte + 32*32*3 pixel bytes (R, G, B planes, row-major
+within a plane).  The reference wraps the raw bytes as a
+``RowColumnMajorByteArrayVectorizedImage`` (utils/images/Image.scala:263-286)
+— its (x, y) axes are the transpose of the usual (row, col) convention, which
+is irrelevant to the CIFAR pipeline (every downstream op is spatially
+symmetric).  Here images load as ``f32[N, 32, 32, 3]`` (row, col, RGB) with
+values in [0, 255], matching the reference's unsigned-byte reads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+NROW = 32
+NCOL = 32
+NCHAN = 3
+RECORD_BYTES = 1 + NROW * NCOL * NCHAN
+
+
+@dataclass
+class LabeledImageBatch:
+    """Batch analog of the reference's RDD[LabeledImage]."""
+
+    images: np.ndarray  # [N, H, W, C] f32
+    labels: np.ndarray  # [N] int32
+
+    def __len__(self):
+        return self.images.shape[0]
+
+
+def cifar_loader(path: str) -> LabeledImageBatch:
+    raw = np.fromfile(path, dtype=np.uint8)
+    if raw.size % RECORD_BYTES != 0:
+        raise ValueError(
+            f"{path}: size {raw.size} not a multiple of CIFAR record "
+            f"({RECORD_BYTES} bytes)"
+        )
+    records = raw.reshape(-1, RECORD_BYTES)
+    labels = records[:, 0].astype(np.int32)
+    images = (
+        records[:, 1:]
+        .reshape(-1, NCHAN, NROW, NCOL)
+        .transpose(0, 2, 3, 1)
+        .astype(np.float32)
+    )
+    return LabeledImageBatch(images=images, labels=labels)
+
+
+CifarLoader = cifar_loader

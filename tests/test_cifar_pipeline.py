@@ -1,0 +1,69 @@
+"""RandomPatchCifar end-to-end on synthetic CIFAR binaries (the reference
+exercises loaders on miniature datasets in test resources, SURVEY §4.6)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders.cifar import RECORD_BYTES, cifar_loader
+from keystone_tpu.workloads.cifar_random_patch import RandomCifarConfig, run
+
+
+def write_synthetic_cifar(path, n, rng, num_classes=4, base=None):
+    """Class-colored blobs + noise: separable but not trivial.  ``base`` (the
+    class color palette) must be shared between train and test splits."""
+    labels = rng.integers(0, num_classes, n).astype(np.uint8)
+    if base is None:
+        base = rng.uniform(40, 215, (num_classes, 3))
+    recs = np.zeros((n, RECORD_BYTES), np.uint8)
+    for i in range(n):
+        img = base[labels[i]][:, None, None] + rng.normal(0, 25, (3, 32, 32))
+        # add class-dependent spatial structure
+        yy, xx = np.mgrid[0:32, 0:32]
+        img[labels[i] % 3] += 30 * np.sin(xx / (2.0 + labels[i]))
+        recs[i, 0] = labels[i]
+        recs[i, 1:] = np.clip(img, 0, 255).astype(np.uint8).reshape(-1)
+    recs.tofile(path)
+    return labels
+
+
+class TestCifarLoader:
+    def test_roundtrip(self, tmp_path, rng):
+        path = str(tmp_path / "train.bin")
+        labels = write_synthetic_cifar(path, 10, rng)
+        batch = cifar_loader(path)
+        assert batch.images.shape == (10, 32, 32, 3)
+        assert batch.images.dtype == np.float32
+        np.testing.assert_array_equal(batch.labels, labels.astype(np.int32))
+        assert batch.images.min() >= 0.0 and batch.images.max() <= 255.0
+
+    def test_rejects_truncated_file(self, tmp_path):
+        path = str(tmp_path / "bad.bin")
+        np.zeros(RECORD_BYTES + 7, np.uint8).tofile(path)
+        with pytest.raises(ValueError):
+            cifar_loader(path)
+
+
+class TestRandomPatchCifarE2E:
+    def test_learns_synthetic_classes(self, tmp_path, rng):
+        train_path = str(tmp_path / "train.bin")
+        test_path = str(tmp_path / "test.bin")
+        palette = rng.uniform(40, 215, (4, 3))
+        write_synthetic_cifar(train_path, 300, rng, base=palette)
+        write_synthetic_cifar(test_path, 100, rng, base=palette)
+
+        conf = RandomCifarConfig(
+            num_filters=16,
+            patch_size=6,
+            patch_steps=2,
+            pool_size=14,
+            pool_stride=13,
+            alpha=0.25,
+            lam=10.0,
+            whitener_size=2000,
+            featurize_chunk=64,
+            num_classes=4,
+        )
+        results = run(conf, cifar_loader(train_path), cifar_loader(test_path))
+        # chance is 75% error; separable color blobs should be nearly solved
+        assert results["test_error"] < 15.0, results
+        assert results["train_error"] < 10.0, results
