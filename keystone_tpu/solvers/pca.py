@@ -1,0 +1,120 @@
+"""PCA + multi-class LDA (reference src/main/scala/nodes/learning/PCA.scala:16-106,
+LinearDiscriminantAnalysis.scala:17-67).
+
+The reference collects samples to the driver and runs LAPACK ``sgesvd`` /
+Breeze ``eig`` there.  Here both run on-device: the SVD in float32 (as the
+reference's sgesvd) on an HBM-resident sample matrix, and LDA via the
+symmetric whitening trick (Cholesky of S_W + ``eigh``) instead of the
+non-symmetric ``eig(inv(S_W) S_B)`` — same eigenvalues, same projection
+subspace, but a TPU-friendly symmetric eigensolve.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.pipeline import Estimator, LabelEstimator, Transformer, node
+from .linear import LinearMapper
+
+
+@node(data_fields=("pca_mat",))
+class PCATransformer(Transformer):
+    """Project vectors: ``in @ pcaMat`` (reference PCA.scala:16-27 computes
+    ``pcaMat.t * in`` per item — identical for batched rows)."""
+
+    def __init__(self, pca_mat):
+        self.pca_mat = pca_mat
+
+    def __call__(self, batch):
+        return batch @ self.pca_mat
+
+
+@node(data_fields=("pca_mat",))
+class BatchPCATransformer(Transformer):
+    """Project descriptor matrices with descriptors as *columns*
+    (reference PCA.scala:35-40: ``pcaMat.t * in``).  Batch input is
+    ``[N, d, cols]`` -> ``[N, dims, cols]``."""
+
+    def __init__(self, pca_mat):
+        self.pca_mat = pca_mat
+
+    def __call__(self, batch):
+        return jnp.einsum("dk,ndc->nkc", self.pca_mat, batch)
+
+
+def compute_pca(data_mat, dims: int):
+    """The reference's computePCA (PCA.scala:63-106): mean-center, f32 SVD,
+    MATLAB sign convention (largest-|element| of each column positive), first
+    ``dims`` columns of V."""
+    data_mat = jnp.asarray(data_mat, jnp.float32)
+    means = jnp.mean(data_mat, axis=0)
+    data = data_mat - means
+    # full VT only when n < d; for n >= d the reduced VT is the same [d, d]
+    # and full_matrices=True would materialize an [n, n] U (the reference
+    # passes jobu="N" because samples are O(1e6) rows, PCA.scala:57,80-86)
+    n, d = data.shape
+    _, _, vt = jnp.linalg.svd(data, full_matrices=n < d)
+    pca = vt.T  # [d, d], columns = components, descending singular value
+    col_max = jnp.max(pca, axis=0)
+    abs_col_max = jnp.max(jnp.abs(pca), axis=0)
+    signs = jnp.where(col_max == abs_col_max, 1.0, -1.0).astype(pca.dtype)
+    pca = pca * signs
+    return pca[:, :dims]
+
+
+class PCAEstimator(Estimator):
+    """Fit PCA from a sample matrix (reference PCA.scala:46-61; the
+    driver-collect disappears — the sample stays on device)."""
+
+    def __init__(self, dims: int):
+        self.dims = dims
+
+    def fit(self, samples) -> PCATransformer:
+        return PCATransformer(compute_pca(jnp.asarray(samples), self.dims))
+
+
+class LinearDiscriminantAnalysis(LabelEstimator):
+    """Multi-class LDA -> LinearMapper
+    (reference LinearDiscriminantAnalysis.scala:17-67).
+
+    S_W = Σ_c Σ_{x∈c} (x-μ_c)(x-μ_c)ᵀ,  S_B = Σ_c n_c (μ_c-μ)(μ_c-μ)ᵀ.
+    Solved as the symmetric problem ``eigh(L⁻¹ S_B L⁻ᵀ)`` with
+    ``S_W = L Lᵀ`` — eigenvalues match ``eig(inv(S_W) S_B)``; eigenvectors
+    are ``W = L⁻ᵀ Y`` (differ from the reference only by per-vector scale,
+    which is irrelevant to the projection)."""
+
+    def __init__(self, num_dimensions: int):
+        self.num_dimensions = num_dimensions
+
+    def fit(self, data, labels) -> LinearMapper:
+        data = jnp.asarray(data, jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+        labels = jnp.asarray(labels)
+        classes = jnp.unique(labels)
+        total_mean = jnp.mean(data, axis=0)
+        d = data.shape[1]
+
+        sw = jnp.zeros((d, d), data.dtype)
+        sb = jnp.zeros((d, d), data.dtype)
+        for c in classes:
+            mask = labels == c
+            xc = data[mask]
+            mu_c = jnp.mean(xc, axis=0)
+            xm = xc - mu_c
+            sw = sw + xm.T @ xm
+            dm = (mu_c - total_mean)[:, None]
+            sb = sb + xc.shape[0] * (dm @ dm.T)
+
+        l = jnp.linalg.cholesky(sw)
+        linv_sb = jax.scipy.linalg.solve_triangular(l, sb, lower=True)
+        m = jax.scipy.linalg.solve_triangular(l, linv_sb.T, lower=True).T
+        m = 0.5 * (m + m.T)  # symmetrize fp error
+        eigvals, y = jnp.linalg.eigh(m)
+        order = jnp.argsort(-jnp.abs(eigvals))[: self.num_dimensions]
+        w = jax.scipy.linalg.solve_triangular(
+            l.T, y[:, order], lower=False
+        )
+        # Breeze's eig returns unit eigenvectors; normalize so the projection
+        # matrix matches the reference's (up to per-column sign).
+        w = w / jnp.linalg.norm(w, axis=0, keepdims=True)
+        return LinearMapper(w)

@@ -50,7 +50,10 @@ class ZCAWhitenerEstimator(Estimator):
         # Full VT (as the reference's sgesvd jobvt="A"): when n < d the
         # null-space components have s=0 and still get the 0.1 shrinkage,
         # i.e. a 0.1^-0.5 gain — dropping them would change the transform.
-        _, s, vt = jnp.linalg.svd(centered, full_matrices=True)
+        # full_matrices only when n < d: otherwise the reduced VT is already
+        # [d, d] and full_matrices=True would materialize an [n, n] U
+        # (the reference avoids U entirely via sgesvd jobu="N").
+        _, s, vt = jnp.linalg.svd(centered, full_matrices=n < d)
         s2 = jnp.zeros((d,), s.dtype).at[: s.shape[0]].set((s * s) / (n - 1.0))
         scale = (s2 + 0.1) ** -0.5
         whitener = (vt.T * scale) @ vt

@@ -1,0 +1,114 @@
+"""PCA / LDA tests mirroring the reference suite criteria
+(src/test/scala/nodes/learning/PCASuite.scala: projected covariance must be
+diagonal; LinearDiscriminantAnalysisSuite.scala: known projection vectors,
+diagonal covariance after projection)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.solvers.pca import (
+    BatchPCATransformer,
+    LinearDiscriminantAnalysis,
+    PCAEstimator,
+    compute_pca,
+)
+from keystone_tpu.utils.stats import about_eq
+
+
+class TestPCA:
+    def test_projected_covariance_diagonal(self, rng):
+        # PCASuite criterion: covariance of PCA-projected data is diagonal
+        n, d, dims = 500, 10, 4
+        base = rng.normal(size=(n, d)).astype(np.float32)
+        mixed = base @ rng.normal(size=(d, d)).astype(np.float32)
+        pca = PCAEstimator(dims).fit(jnp.asarray(mixed))
+        out = np.asarray(pca(jnp.asarray(mixed)))
+        cov = np.cov(out, rowvar=False)
+        off = cov - np.diag(np.diag(cov))
+        assert np.all(np.abs(off) < 1e-2 * np.max(np.diag(cov)))
+
+    def test_matches_numpy_svd(self, rng):
+        n, d, dims = 60, 8, 5
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        got = np.asarray(compute_pca(jnp.asarray(x), dims))
+        xc = x - x.mean(axis=0)
+        _, _, vt = np.linalg.svd(xc, full_matrices=True)
+        pca = vt.T
+        signs = np.where(pca.max(axis=0) == np.abs(pca).max(axis=0), 1.0, -1.0)
+        expected = (pca * signs)[:, :dims]
+        # SVD sign/column conventions agree after the MATLAB sign fix
+        assert about_eq(np.abs(got), np.abs(expected), 1e-3)
+        assert about_eq(got, expected, 1e-3)
+
+    def test_variance_ordering(self, rng):
+        # first component captures the dominant direction
+        n = 1000
+        x = np.zeros((n, 3), np.float32)
+        x[:, 0] = rng.normal(scale=10.0, size=n)
+        x[:, 1] = rng.normal(scale=1.0, size=n)
+        x[:, 2] = rng.normal(scale=0.1, size=n)
+        pca_mat = np.asarray(compute_pca(jnp.asarray(x), 3))
+        assert abs(pca_mat[0, 0]) > 0.99  # component 0 ≈ axis 0
+        assert abs(pca_mat[1, 1]) > 0.99
+
+    def test_batch_pca_transformer(self, rng):
+        mats = rng.normal(size=(3, 8, 11)).astype(np.float32)  # [N, d, cols]
+        pca_mat = rng.normal(size=(8, 4)).astype(np.float32)
+        out = np.asarray(BatchPCATransformer(jnp.asarray(pca_mat))(jnp.asarray(mats)))
+        assert out.shape == (3, 4, 11)
+        for i in range(3):
+            assert about_eq(out[i], pca_mat.T @ mats[i], 1e-4)
+
+
+def naive_lda(data, labels, k):
+    """Direct eig(inv(Sw) Sb) per the reference, via numpy."""
+    classes = np.unique(labels)
+    mu = data.mean(axis=0)
+    d = data.shape[1]
+    sw = np.zeros((d, d))
+    sb = np.zeros((d, d))
+    for c in classes:
+        xc = data[labels == c]
+        mc = xc.mean(axis=0)
+        xm = xc - mc
+        sw += xm.T @ xm
+        dm = (mc - mu)[:, None]
+        sb += len(xc) * dm @ dm.T
+    vals, vecs = np.linalg.eig(np.linalg.inv(sw) @ sb)
+    order = np.argsort(-np.abs(vals))[:k]
+    w = np.real(vecs[:, order])
+    return w / np.linalg.norm(w, axis=0, keepdims=True)
+
+
+class TestLDA:
+    def test_matches_direct_eig(self, rng):
+        n_per, d, k = 80, 5, 2
+        means = rng.normal(scale=3.0, size=(3, d))
+        data = np.concatenate(
+            [means[c] + rng.normal(size=(n_per, d)) for c in range(3)]
+        ).astype(np.float64)
+        labels = np.repeat(np.arange(3), n_per)
+        lm = LinearDiscriminantAnalysis(k).fit(jnp.asarray(data), jnp.asarray(labels))
+        got = np.asarray(lm.x)
+        expected = naive_lda(data, labels, k)
+        for j in range(k):  # sign-insensitive, per the reference suite
+            assert about_eq(got[:, j], expected[:, j], 1e-2) or about_eq(
+                got[:, j], -expected[:, j], 1e-2
+            ), (got[:, j], expected[:, j])
+
+    def test_separates_classes(self, rng):
+        n_per, d = 100, 6
+        means = rng.normal(scale=4.0, size=(4, d))
+        data = np.concatenate(
+            [means[c] + rng.normal(size=(n_per, d)) for c in range(4)]
+        ).astype(np.float32)
+        labels = np.repeat(np.arange(4), n_per)
+        lm = LinearDiscriminantAnalysis(3).fit(jnp.asarray(data), jnp.asarray(labels))
+        proj = np.asarray(lm(jnp.asarray(data)))
+        # between-class spread dominates within-class spread after projection
+        centroids = np.stack([proj[labels == c].mean(axis=0) for c in range(4)])
+        within = np.mean(
+            [proj[labels == c].std(axis=0).mean() for c in range(4)]
+        )
+        between = np.std(centroids, axis=0).mean()
+        assert between > 3.0 * within
