@@ -1,0 +1,94 @@
+"""Fisher vector encoding (reference
+src/main/scala/nodes/images/external/FisherVector.scala:14-35, delegating to
+the vendored enceval ``fisher<float>`` with alpha=1.0, pnorm=0 —
+src/main/cpp/EncEval.cxx:67-69,97).
+
+Improved-FV formulation (Perronnin et al.), mean and variance gradients only
+(the enceval output length is exactly ``2·d·K``, EncEval.cxx:41):
+
+    G_μk = (1/(N√π_k)) Σ_n q_nk (x_n − μ_k)/σ_k
+    G_σk = (1/(N√(2π_k))) Σ_n q_nk [((x_n − μ_k)/σ_k)² − 1]
+
+alpha=1 / pnorm=0 mean *no* power- or L2-normalization inside the encoder —
+the pipelines apply SignedHellinger + NormalizeRows as separate nodes
+(reference ImageNetSiftLcsFV.scala:29-39), exactly as here.
+
+Output layout matches the reference wrapper: ``[d, 2K]`` per image — columns
+0..K-1 the mean gradients, K..2K-1 the variance gradients
+(FisherVector.scala:33-34 wraps the flat enceval buffer as
+DenseMatrix(numDims, numCentroids*2)).
+
+TPU-native: posteriors are one [n, k] gemm + softmax; the sufficient
+statistics (s0, s1, s2) are three gemms; everything vmaps over the image
+axis, with an optional validity mask for ragged descriptor counts (XLA needs
+static shapes, SURVEY §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.pipeline import Transformer
+from ..solvers.gmm import GaussianMixtureModel, _log_resp
+
+
+def fisher_vector(descriptors, means, variances, weights, mask=None):
+    """FV of one descriptor matrix ``[cols, d]`` (descriptors as rows here;
+    callers with column-major descriptor matrices transpose first).
+
+    ``mask``: optional [cols] 0/1 validity mask for padded descriptors —
+    padded columns contribute nothing and N counts only valid ones.
+    """
+    x = descriptors
+    logr = _log_resp(x, means, variances, weights)
+    q = jax.nn.softmax(logr, axis=-1)  # [n, k]
+    if mask is not None:
+        q = q * mask[:, None]
+        n_valid = jnp.sum(mask)
+    else:
+        n_valid = jnp.asarray(x.shape[0], x.dtype)
+
+    s0 = jnp.sum(q, axis=0)  # [k]
+    s1 = x.T @ q  # [d, k]
+    s2 = (x * x).T @ q  # [d, k]
+
+    sigma = jnp.sqrt(variances)  # [d, k]
+    n_safe = jnp.maximum(n_valid, 1.0)
+    g_mean = (s1 - means * s0) / (sigma * jnp.sqrt(weights) * n_safe)
+    g_var = (
+        (s2 - 2.0 * means * s1 + (means * means - variances) * s0)
+        / (variances * jnp.sqrt(2.0 * weights) * n_safe)
+    )
+    return jnp.concatenate([g_mean, g_var], axis=1)  # [d, 2K]
+
+
+class FisherVector(Transformer):
+    """Batched FV node: ``[N, d, cols]`` descriptor matrices (the
+    BatchPCATransformer output convention, descriptors as columns) ->
+    ``[N, d, 2K]``."""
+
+    def __init__(self, gmm: GaussianMixtureModel):
+        self.gmm = gmm
+        self.num_dims = gmm.dim
+        self.num_centroids = gmm.k
+        self.num_features = self.num_dims * self.num_centroids * 2
+
+    def __call__(self, batch, mask=None):
+        """``mask``: optional [N, cols] validity for ragged descriptor counts."""
+
+        def one(mat, m):
+            return fisher_vector(
+                mat.T, self.gmm.means, self.gmm.variances, self.gmm.weights, m
+            )
+
+        if mask is None:
+            return jax.vmap(lambda mat: one(mat, None))(batch)
+        return jax.vmap(one)(batch, mask)
+
+
+jax.tree_util.register_pytree_node(
+    FisherVector,
+    lambda fv: ((fv.gmm,), None),
+    lambda _, kids: FisherVector(kids[0]),
+)

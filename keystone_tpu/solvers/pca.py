@@ -14,6 +14,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from ..core.pipeline import Estimator, LabelEstimator, Transformer, node
 from .linear import LinearMapper
 
@@ -89,23 +91,32 @@ class LinearDiscriminantAnalysis(LabelEstimator):
 
     def fit(self, data, labels) -> LinearMapper:
         data = jnp.asarray(data, jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
-        labels = jnp.asarray(labels)
-        classes = jnp.unique(labels)
+        labels_np = np.asarray(labels)
+        classes = np.unique(labels_np)
         total_mean = jnp.mean(data, axis=0)
-        d = data.shape[1]
+        n = data.shape[0]
 
-        sw = jnp.zeros((d, d), data.dtype)
-        sb = jnp.zeros((d, d), data.dtype)
-        for c in classes:
-            mask = labels == c
-            xc = data[mask]
-            mu_c = jnp.mean(xc, axis=0)
-            xm = xc - mu_c
-            sw = sw + xm.T @ xm
-            dm = (mu_c - total_mean)[:, None]
-            sb = sb + xc.shape[0] * (dm @ dm.T)
+        # One-hot gemms instead of per-class gathers (no data-dependent
+        # shapes; two gemms total regardless of class count):
+        #   S_total = Σ (x-μ)(x-μ)ᵀ,  S_B = Σ_c n_c (μ_c-μ)(μ_c-μ)ᵀ,
+        #   S_W = S_total − S_B.
+        onehot = jnp.asarray(
+            (classes[:, None] == labels_np[None, :]).astype(np.float32), data.dtype
+        )  # [C, n]
+        counts = jnp.sum(onehot, axis=1)  # [C]
+        class_means = (onehot @ data) / counts[:, None]  # [C, d]
+        xm = data - total_mean
+        s_total = xm.T @ xm
+        dm = (class_means - total_mean) * jnp.sqrt(counts)[:, None]
+        sb = dm.T @ dm
+        sw = s_total - sb
 
         l = jnp.linalg.cholesky(sw)
+        if not bool(jnp.all(jnp.isfinite(l))):
+            raise ValueError(
+                "S_W is singular (need n_samples - n_classes >= n_features); "
+                "LDA projection would be NaN"
+            )
         linv_sb = jax.scipy.linalg.solve_triangular(l, sb, lower=True)
         m = jax.scipy.linalg.solve_triangular(l, linv_sb.T, lower=True).T
         m = 0.5 * (m + m.T)  # symmetrize fp error

@@ -52,8 +52,8 @@ def _class_solves(
     mixture_weight,
     n_max: int,
 ):
-    """One per-class solve sweep (reference :228-263) via sequential lax.map —
-    returns ΔW [d, C]."""
+    """One per-class solve sweep (reference :228-263) via sequential
+    lax.scan — returns ΔW [d, C]."""
     d = xb_pad.shape[1]
     c_total = starts.shape[0]
     w = mixture_weight
@@ -169,6 +169,12 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         )
 
         models = [jnp.zeros((b.shape[1], n_classes), dtype) for b in blocks]
+        # Pad each block once (blocks are constant across passes); only the
+        # residual padding changes per iteration.
+        blocks_padded = [
+            jnp.concatenate([b, jnp.zeros((n_max, b.shape[1]), dtype)], axis=0)
+            for b in blocks
+        ]
         tail = jnp.zeros((n_max, n_classes), dtype)
         block_stats: list[tuple | None] = [None] * len(blocks)
         lam_arr = jnp.asarray(self.lam, dtype)
@@ -176,10 +182,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         for _pass in range(self.num_iter):
             for bi, xb in enumerate(blocks):
-                d_b = xb.shape[1]
-                xb_pad = jnp.concatenate(
-                    [xb, jnp.zeros((n_max, d_b), dtype)], axis=0
-                )
+                xb_pad = blocks_padded[bi]
                 if block_stats[bi] is None:
                     pop_mean = jnp.mean(xb, axis=0)
                     ata = xb.T @ xb
