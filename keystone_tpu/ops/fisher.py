@@ -29,7 +29,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..core.pipeline import Transformer
+from ..core.pipeline import Transformer, node
 from ..solvers.gmm import GaussianMixtureModel, _log_resp
 
 
@@ -63,6 +63,7 @@ def fisher_vector(descriptors, means, variances, weights, mask=None):
     return jnp.concatenate([g_mean, g_var], axis=1)  # [d, 2K]
 
 
+@node(data_fields=("gmm",))
 class FisherVector(Transformer):
     """Batched FV node: ``[N, d, cols]`` descriptor matrices (the
     BatchPCATransformer output convention, descriptors as columns) ->
@@ -70,9 +71,18 @@ class FisherVector(Transformer):
 
     def __init__(self, gmm: GaussianMixtureModel):
         self.gmm = gmm
-        self.num_dims = gmm.dim
-        self.num_centroids = gmm.k
-        self.num_features = self.num_dims * self.num_centroids * 2
+
+    @property
+    def num_dims(self) -> int:
+        return self.gmm.dim
+
+    @property
+    def num_centroids(self) -> int:
+        return self.gmm.k
+
+    @property
+    def num_features(self) -> int:
+        return self.num_dims * self.num_centroids * 2
 
     def __call__(self, batch, mask=None):
         """``mask``: optional [N, cols] validity for ragged descriptor counts."""
@@ -85,10 +95,3 @@ class FisherVector(Transformer):
         if mask is None:
             return jax.vmap(lambda mat: one(mat, None))(batch)
         return jax.vmap(one)(batch, mask)
-
-
-jax.tree_util.register_pytree_node(
-    FisherVector,
-    lambda fv: ((fv.gmm,), None),
-    lambda _, kids: FisherVector(kids[0]),
-)

@@ -84,18 +84,36 @@ def _log_resp(x, means, variances, weights):
 
 
 @jax.jit
-def _em_step(x, means, variances, weights, var_floor):
+def _e_stats(x, means, variances, weights):
+    """Sufficient statistics (s0, s1, s2, Σ log-norm) for one sample chunk."""
     logr = _log_resp(x, means, variances, weights)
     log_norm = jax.scipy.special.logsumexp(logr, axis=1, keepdims=True)
     q = jnp.exp(logr - log_norm)  # [n, k]
     s0 = jnp.sum(q, axis=0)  # [k]
     s1 = x.T @ q  # [d, k]
     s2 = (x * x).T @ q  # [d, k]
-    new_means = s1 / s0
-    new_vars = jnp.maximum(s2 / s0 - new_means * new_means, var_floor)
-    new_weights = s0 / x.shape[0]
-    llh = jnp.mean(log_norm)
-    return new_means, new_vars, new_weights, llh
+    return s0, s1, s2, jnp.sum(log_norm)
+
+
+def _em_step(x, means, variances, weights, var_floor, chunk: int):
+    """One EM iteration, E-step chunked over samples so the [n, k] posterior
+    matrix never exceeds one chunk's footprint."""
+    n = x.shape[0]
+    d, k = means.shape
+    s0 = jnp.zeros((k,), x.dtype)
+    s1 = jnp.zeros((d, k), x.dtype)
+    s2 = jnp.zeros((d, k), x.dtype)
+    llh_sum = jnp.zeros((), x.dtype)
+    for i in range(0, n, chunk):
+        c0, c1, c2, cl = _e_stats(x[i : i + chunk], means, variances, weights)
+        s0, s1, s2, llh_sum = s0 + c0, s1 + c1, s2 + c2, llh_sum + cl
+    # Floor the responsibility mass: an empty/collapsed component would give
+    # 0/0 = NaN means and poison the whole fit.
+    s0_safe = jnp.maximum(s0, 1e-10)
+    new_means = s1 / s0_safe
+    new_vars = jnp.maximum(s2 / s0_safe - new_means * new_means, var_floor)
+    new_weights = s0 / n
+    return new_means, new_vars, new_weights, llh_sum / n
 
 
 class GaussianMixtureModelEstimator(Estimator):
@@ -109,12 +127,14 @@ class GaussianMixtureModelEstimator(Estimator):
         tol: float = 1e-4,
         seed: int = 42,
         var_floor_factor: float = 1e-3,
+        chunk: int = 1 << 18,
     ):
         self.k = k
         self.max_iter = max_iter
         self.tol = tol
         self.seed = seed
         self.var_floor_factor = var_floor_factor
+        self.chunk = chunk
 
     def fit(self, samples) -> GaussianMixtureModel:
         x = jnp.asarray(samples, jnp.float32)
@@ -133,7 +153,7 @@ class GaussianMixtureModelEstimator(Estimator):
         prev_llh = -jnp.inf
         for _ in range(self.max_iter):
             means, variances, weights, llh = _em_step(
-                x, means, variances, weights, var_floor
+                x, means, variances, weights, var_floor, self.chunk
             )
             llh = float(llh)
             if abs(llh - prev_llh) < self.tol * max(1.0, abs(llh)):

@@ -97,19 +97,20 @@ class LinearDiscriminantAnalysis(LabelEstimator):
         n = data.shape[0]
 
         # One-hot gemms instead of per-class gathers (no data-dependent
-        # shapes; two gemms total regardless of class count):
-        #   S_total = Σ (x-μ)(x-μ)ᵀ,  S_B = Σ_c n_c (μ_c-μ)(μ_c-μ)ᵀ,
-        #   S_W = S_total − S_B.
+        # shapes; a few gemms total regardless of class count).  S_W is
+        # accumulated directly from class-mean-centered rows — no
+        # S_total − S_B subtraction, which cancels catastrophically in f32
+        # when between-class scatter dominates.
+        class_of_row = np.searchsorted(classes, labels_np)
         onehot = jnp.asarray(
             (classes[:, None] == labels_np[None, :]).astype(np.float32), data.dtype
         )  # [C, n]
         counts = jnp.sum(onehot, axis=1)  # [C]
         class_means = (onehot @ data) / counts[:, None]  # [C, d]
-        xm = data - total_mean
-        s_total = xm.T @ xm
+        centered = data - class_means[jnp.asarray(class_of_row)]
+        sw = centered.T @ centered
         dm = (class_means - total_mean) * jnp.sqrt(counts)[:, None]
         sb = dm.T @ dm
-        sw = s_total - sb
 
         l = jnp.linalg.cholesky(sw)
         if not bool(jnp.all(jnp.isfinite(l))):

@@ -13,7 +13,7 @@ TPU-native re-design:
 * population statistics are plain gemms over the sorted [N, d] block — under
   ``jit`` with row-sharded inputs XLA lowers them to local gram + ICI
   all-reduce (the treeReduce replacement);
-* the per-class solves run inside one jitted ``lax.map`` over classes — each
+* the per-class solves run inside one jitted ``lax.scan`` over classes — each
   step dynamic-slices the class's rows (padded to the max class size) out of
   the sorted array, builds the mixture-weighted normal equations, and does a
   dense solve; no padded [C, n_max, d] tensor is ever materialized;
@@ -169,32 +169,37 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         )
 
         models = [jnp.zeros((b.shape[1], n_classes), dtype) for b in blocks]
-        # Pad each block once (blocks are constant across passes); only the
-        # residual padding changes per iteration.
-        blocks_padded = [
-            jnp.concatenate([b, jnp.zeros((n_max, b.shape[1]), dtype)], axis=0)
-            for b in blocks
-        ]
+        # Keep ONLY the padded copy of each block (zero tail of n_max rows):
+        # the zero tail contributes nothing to gemms/sums, so population
+        # statistics use xb_pad directly with the true count n — no second
+        # full copy of the design matrix stays resident.
+        blocks_padded = []
+        for b in blocks:
+            blocks_padded.append(
+                jnp.concatenate([b, jnp.zeros((n_max, b.shape[1]), dtype)], axis=0)
+            )
+        del blocks
+        onehot_pad = jnp.concatenate(
+            [class_onehot, jnp.zeros((n_classes, n_max), dtype)], axis=1
+        )
         tail = jnp.zeros((n_max, n_classes), dtype)
-        block_stats: list[tuple | None] = [None] * len(blocks)
+        block_stats: list[tuple | None] = [None] * len(blocks_padded)
         lam_arr = jnp.asarray(self.lam, dtype)
         w_arr = jnp.asarray(w, dtype)
 
         for _pass in range(self.num_iter):
-            for bi, xb in enumerate(blocks):
-                xb_pad = blocks_padded[bi]
+            for bi, xb_pad in enumerate(blocks_padded):
+                res_pad = jnp.concatenate([residual, tail], axis=0)
                 if block_stats[bi] is None:
-                    pop_mean = jnp.mean(xb, axis=0)
-                    ata = xb.T @ xb
+                    pop_mean = jnp.sum(xb_pad, axis=0) / n
+                    ata = xb_pad.T @ xb_pad
                     pop_cov = ata / n - jnp.outer(pop_mean, pop_mean)
-                    class_means = (class_onehot @ xb) / counts.astype(dtype)[:, None]
+                    class_means = (onehot_pad @ xb_pad) / counts.astype(dtype)[:, None]
                     joint_means = w * class_means + (1.0 - w) * pop_mean
                     block_stats[bi] = (pop_cov, pop_mean, joint_means)
                 else:
                     pop_cov, pop_mean, joint_means = block_stats[bi]
-                pop_xtr = xb.T @ residual / n
-
-                res_pad = jnp.concatenate([residual, tail], axis=0)
+                pop_xtr = xb_pad.T @ res_pad / n
                 dw = _class_solves(
                     xb_pad,
                     res_pad,
@@ -211,7 +216,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     n_max,
                 )
                 models[bi] = models[bi] + dw
-                residual = residual - xb @ dw
+                residual = residual - (xb_pad @ dw)[: residual.shape[0]]
                 residual_mean = _residual_class_means(
                     residual, class_onehot, counts.astype(dtype)
                 )

@@ -1,0 +1,149 @@
+"""Dense SIFT / LCS extractor tests.
+
+The reference's golden-file fixtures (feats128.csv for SIFT) are absent from
+its own test resources, so the criteria here are: structural invariants
+(shape, quantization range, descriptor count), naive-loop equivalence for
+LCS against a direct transcription of the reference's per-pixel code, and
+behavioral SIFT properties (rotation shifts orientation mass, flat images
+give zero descriptors, contrast threshold)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.lcs import LCSExtractor, _same_conv2d_zero
+from keystone_tpu.ops.sift import SIFTExtractor
+from keystone_tpu.utils.stats import about_eq
+
+
+class TestSIFT:
+    def test_shapes_and_quantization(self, rng):
+        img = rng.uniform(size=(2, 48, 48)).astype(np.float32)
+        ext = SIFTExtractor(step_size=4, bin_size=4, scales=2, scale_step=0)
+        out = np.asarray(ext(jnp.asarray(img)))
+        assert out.shape[0] == 2 and out.shape[1] == 128
+        assert out.shape[2] == ext.num_descriptors(48, 48)
+        assert out.min() >= 0.0 and out.max() <= 255.0
+        assert np.all(out == np.floor(out))  # quantized
+        assert out.max() > 0  # something fired on random texture
+
+    def test_flat_image_zero_descriptors(self):
+        img = jnp.full((1, 40, 40), 0.5, jnp.float32)
+        ext = SIFTExtractor(step_size=4, bin_size=4, scales=2, scale_step=0)
+        out = np.asarray(ext(img))
+        # no gradient -> norms below contrast threshold -> all zeroed
+        assert np.all(out == 0.0)
+
+    def test_contrast_threshold_zeroes_weak_regions(self, rng):
+        # left half flat, right half textured: descriptors fully inside the
+        # flat half must be zero, textured ones nonzero
+        img = np.full((1, 60, 60), 0.5, np.float32)
+        img[0, :, 30:] = rng.uniform(size=(60, 30)).astype(np.float32)
+        ext = SIFTExtractor(step_size=3, bin_size=4, scales=1, scale_step=0)
+        out = np.asarray(ext(jnp.asarray(img)))
+        col_norms = np.linalg.norm(out[0], axis=0)
+        assert (col_norms == 0).any() and (col_norms > 0).any()
+
+    def test_90deg_rotation_permutes_orientations(self, rng):
+        # rotating the image by 90° must keep descriptor energy but move it
+        # across orientation bins: total energy is preserved ~exactly
+        img = rng.uniform(size=(36, 36)).astype(np.float32)
+        ext = SIFTExtractor(step_size=3, bin_size=4, scales=1, scale_step=0)
+        a = np.asarray(ext(jnp.asarray(img[None])))
+        b = np.asarray(ext(jnp.asarray(np.rot90(img).copy()[None])))
+        assert a.shape == b.shape
+        assert abs(a.sum() - b.sum()) / max(a.sum(), 1.0) < 0.05
+
+    def test_multiscale_grids_nested_when_steps_equal(self):
+        # scaleStep=0: all scales share step; offsets are arranged so frame
+        # centers coincide (VLFeat.cxx:92-95)
+        ext = SIFTExtractor(step_size=2, bin_size=4, scales=3, scale_step=0)
+        from keystone_tpu.ops.sift import _scale_geometry
+
+        centers = []
+        for s in range(3):
+            b = 4 + 2 * s
+            ys, xs = _scale_geometry(64, 64, 2, b, 3, s)
+            centers.append(ys[0] + 1.5 * b)  # first frame center
+        assert centers[0] == centers[1] == centers[2]
+
+
+def naive_lcs(img, stride, stride_start, sub):
+    """Direct transcription of LCSExtractor.scala:52-126 (with x = column
+    axis, y = row axis; spatially symmetric ops make the convention moot)."""
+    h, w, c = img.shape
+    box = np.full(sub, 1.0 / sub)
+
+    def conv_same(plane):
+        padded = np.zeros((h + sub - 1, w + sub - 1))
+        lo = (sub - 1) // 2
+        padded[lo : lo + h, lo : lo + w] = plane
+        mid = np.zeros((h, w + sub - 1))
+        for y in range(h):
+            for x in range(w + sub - 1):
+                acc = 0.0
+                for i in range(sub):
+                    acc += padded[y + i, x] * box[sub - 1 - i]
+                mid[y, x] = acc
+        out = np.zeros((h, w))
+        for y in range(h):
+            for x in range(w):
+                acc = 0.0
+                for i in range(sub):
+                    acc += mid[y, x + i] * box[sub - 1 - i]
+                out[y, x] = acc
+        return out
+
+    means = [conv_same(img[:, :, ch]) for ch in range(c)]
+    stds = [
+        np.sqrt(np.maximum(conv_same(img[:, :, ch] ** 2) - means[ch] ** 2, 0))
+        for ch in range(c)
+    ]
+    xs = list(range(stride_start, w - stride_start, stride))
+    ys = list(range(stride_start, h - stride_start, stride))
+    nbr = list(range(-2 * sub + sub // 2 - 1, sub + sub // 2 - 1 + 1, sub))
+    cols = []
+    for x in xs:
+        for y in ys:
+            vals = []
+            for ch in range(c):
+                for nx in nbr:
+                    for ny in nbr:
+                        vals.append(means[ch][y + ny, x + nx])
+                        vals.append(stds[ch][y + ny, x + nx])
+            cols.append(vals)
+    return np.array(cols).T  # [descDim, K]
+
+
+class TestLCS:
+    def test_conv_same_matches_reference_padding(self, rng):
+        img = rng.uniform(size=(1, 7, 9, 1)).astype(np.float32)
+        box = np.full(4, 0.25, np.float32)
+        got = np.asarray(_same_conv2d_zero(jnp.asarray(img), box, box))[0, :, :, 0]
+        h, w = 7, 9
+        padded = np.zeros((h + 3, w + 3))
+        padded[1 : 1 + h, 1 : 1 + w] = img[0, :, :, 0]  # lo = (4-1)//2 = 1
+        full = np.zeros((h, w))
+        for y in range(h):
+            for x in range(w):
+                acc = 0.0
+                for i in range(4):
+                    for j in range(4):
+                        acc += padded[y + i, x + j] * box[3 - i] * box[3 - j]
+                full[y, x] = acc
+        assert about_eq(got, full, 1e-4)
+
+    def test_matches_naive_transcription(self, rng):
+        img = rng.uniform(size=(32, 32, 3)).astype(np.float32)
+        ext = LCSExtractor(stride=5, stride_start=12, sub_patch_size=3)
+        got = np.asarray(ext(jnp.asarray(img[None])))[0]
+        expected = naive_lcs(img.astype(np.float64), 5, 12, 3)
+        assert got.shape == expected.shape
+        assert about_eq(got, expected, 1e-3)
+
+    def test_descriptor_dim_96_for_rgb(self, rng):
+        # the canonical config: 4x4 neighborhood x 3 channels x (mean, std)
+        img = rng.uniform(size=(1, 64, 64, 3)).astype(np.float32)
+        ext = LCSExtractor(stride=4, stride_start=16, sub_patch_size=6)
+        out = np.asarray(ext(jnp.asarray(img)))
+        assert out.shape[1] == 96
+        assert out.shape[2] == ext.num_keypoints(64, 64)
