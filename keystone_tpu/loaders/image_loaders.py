@@ -1,0 +1,141 @@
+"""Tar-archive image loaders for VOC / ImageNet
+(reference src/main/scala/loaders/VOCLoader.scala:28-64,
+ImageNetLoader.scala:11-41, ImageLoaderUtils.scala:32-100).
+
+The reference streams tars from HDFS and decodes JPEGs with javax ImageIO
+per executor (synchronized — ImageUtils.scala:17).  Here the host-side
+Python path decodes with PIL into ``f32[H, W, 3]`` BGR arrays in [0, 255]
+(the reference's ByteArrayVectorizedImage is BGR; GrayScaler assumes it);
+the native C++ ingest library (keystone_tpu/native) replaces this path for
+throughput when built.
+
+Images of differing sizes are kept as per-image arrays; workloads bucket
+them by shape before featurizing (XLA wants static shapes).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+from dataclasses import dataclass
+
+import numpy as np
+
+VOC_NUM_CLASSES = 20  # constant of the VOC 2007 dataset
+IMAGENET_NUM_CLASSES = 1000
+
+MIN_DIM = 36  # reference ImageUtils.loadImage rejects images < 36px (:23-27)
+
+
+@dataclass
+class MultiLabeledImages:
+    """Batch analog of RDD[MultiLabeledImage]."""
+
+    images: list  # of f32[H, W, 3] BGR arrays
+    labels: list  # of list[int]
+    filenames: list
+
+    def __len__(self):
+        return len(self.images)
+
+
+@dataclass
+class LabeledImages:
+    images: list
+    labels: np.ndarray  # [N] int32
+    filenames: list
+
+    def __len__(self):
+        return len(self.images)
+
+
+def decode_image(data: bytes) -> np.ndarray | None:
+    """JPEG/PNG bytes -> f32[H, W, 3] BGR in [0, 255]; None when rejected
+    (the reference logs and skips undecodable/small/odd-channel images,
+    ImageLoaderUtils.scala:78-96)."""
+    from PIL import Image as PILImage
+
+    try:
+        img = PILImage.open(io.BytesIO(data))
+        if img.mode not in ("RGB", "L"):
+            img = img.convert("RGB")
+        arr = np.asarray(img, np.float32)
+    except Exception:
+        return None
+    if arr.ndim == 2:  # grayscale triplicated (ImageConversions.scala:26-37)
+        arr = np.stack([arr] * 3, axis=-1)
+    if arr.shape[0] < MIN_DIM or arr.shape[1] < MIN_DIM:
+        return None
+    return arr[:, :, ::-1].copy()  # RGB -> BGR
+
+
+def _tar_files(path: str) -> list[str]:
+    if os.path.isdir(path):
+        return sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if f.endswith((".tar", ".tar.gz", ".tgz"))
+        )
+    return [path]
+
+
+def _iter_tar_images(path: str):
+    """Yield (member_name, image) for each decodable image in the tar(s)."""
+    for tar_path in _tar_files(path):
+        with tarfile.open(tar_path) as tf:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                f = tf.extractfile(member)
+                if f is None:
+                    continue
+                img = decode_image(f.read())
+                if img is not None:
+                    yield member.name.lstrip("./"), img
+
+
+def voc_loader(data_path: str, labels_path: str, name_prefix: str = "VOCdevkit/VOC2007/JPEGImages/") -> MultiLabeledImages:
+    """VOC 2007 loader (reference VOCLoader.scala:42-64): labels CSV has
+    columns (id, class, classname, traintesteval, filename); class ids are
+    1-indexed in the file."""
+    labels_map: dict[str, list[int]] = {}
+    with open(labels_path) as fh:
+        next(fh)  # header
+        for line in fh:
+            parts = line.strip().split(",")
+            fname = parts[4].replace('"', "")
+            labels_map.setdefault(fname, []).append(int(parts[1]) - 1)
+
+    images, labels, filenames = [], [], []
+    for name, img in _iter_tar_images(data_path):
+        # namePrefix acts as a filter (reference ImageLoaderUtils.loadFiles
+        # with Some(namePrefix)): only JPEGImages entries are kept.
+        if not name.startswith(name_prefix):
+            continue
+        if name in labels_map:
+            images.append(img)
+            labels.append(labels_map[name])
+            filenames.append(name)
+    return MultiLabeledImages(images, labels, filenames)
+
+
+def imagenet_loader(data_path: str, labels_path: str) -> LabeledImages:
+    """ImageNet loader (reference ImageNetLoader.scala:25-41): each tar holds
+    one synset directory whose name maps to a class id via the
+    space-separated labels file."""
+    labels_map: dict[str, int] = {}
+    with open(labels_path) as fh:
+        for line in fh:
+            parts = line.split()
+            if len(parts) >= 2:
+                labels_map[parts[0]] = int(parts[1])
+
+    images, labels, filenames = [], [], []
+    for name, img in _iter_tar_images(data_path):
+        synset = name.split("/")[0]
+        if synset in labels_map:
+            images.append(img)
+            labels.append(labels_map[synset])
+            filenames.append(name)
+    return LabeledImages(images, np.asarray(labels, np.int32), filenames)

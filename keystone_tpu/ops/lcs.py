@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.pipeline import Transformer
+from ..core.pipeline import Transformer, node
 
 
 def _same_conv2d_zero(batch, xfilt, yfilt):
@@ -54,6 +54,7 @@ def _same_conv2d_zero(batch, xfilt, yfilt):
     return jnp.moveaxis(out.reshape(n, c, h, w), 1, -1)
 
 
+@node(meta_fields=("stride", "stride_start", "sub_patch_size"))
 class LCSExtractor(Transformer):
     """Batched LCS: ``[N, H, W, C]`` -> ``[N, descDim, numKeypoints]``
     (descriptors as columns, the SIFT/BatchPCA convention).
@@ -109,10 +110,3 @@ class LCSExtractor(Transformer):
         k_total = len(xs) * len(ys)
         desc = pairs.reshape(n, k_total, c * nbr.size * nbr.size * 2)
         return jnp.swapaxes(desc, 1, 2)  # [N, descDim, K]
-
-
-jax.tree_util.register_pytree_node(
-    LCSExtractor,
-    lambda e: ((), (e.stride, e.stride_start, e.sub_patch_size)),
-    lambda meta, _: LCSExtractor(*meta),
-)

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.pipeline import Transformer
+from ..core.pipeline import Transformer, node
 
 MAGNIF = 6.0
 CONTRAST_THRESHOLD = 0.005
@@ -122,6 +122,7 @@ def _scale_geometry(h: int, w: int, step: int, bin_size: int, num_scales: int, s
     return ys, xs
 
 
+@node(meta_fields=("step_size", "bin_size", "scales", "scale_step"))
 class SIFTExtractor(Transformer):
     """Batched dense SIFT: ``[N, H, W]`` (or [N,H,W,1]) grayscale in [0,1]
     -> ``[N, 128, num_desc]`` quantized descriptors as float32
@@ -192,10 +193,3 @@ class SIFTExtractor(Transformer):
         final = jnp.where(norms > CONTRAST_THRESHOLD, final, 0.0)
         quant = jnp.minimum(jnp.floor(512.0 * final), 255.0)
         return jnp.swapaxes(quant, 1, 2)  # [N, 128, D]
-
-
-jax.tree_util.register_pytree_node(
-    SIFTExtractor,
-    lambda s: ((), (s.step_size, s.bin_size, s.scales, s.scale_step)),
-    lambda meta, _: SIFTExtractor(*meta),
-)

@@ -1,0 +1,89 @@
+"""Shared machinery for the Fisher-vector workloads (VOCSIFTFisher,
+ImageNetSiftLcsFV — reference pipelines/images/voc/VOCSIFTFisher.scala and
+pipelines/images/imagenet/ImageNetSiftLcsFV.scala).
+
+The reference maps per-image JNI featurizers over RDDs of arbitrarily-sized
+images.  XLA wants static shapes, so images are grouped into same-shape
+buckets, each bucket is featurized by one jitted program, and the resulting
+fixed-dimension feature rows are scattered back to original order.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.fisher import FisherVector
+from ..ops.images import GrayScaler, PixelScaler
+from ..ops.stats import NormalizeRows, SignedHellingerMapper
+from ..ops.util import MatrixVectorizer
+from ..solvers.gmm import GaussianMixtureModel
+
+
+def bucket_by_shape(images: list) -> dict:
+    """Group per-image arrays by (H, W): shape -> (orig_indices, [n,H,W,C])."""
+    groups: dict = {}
+    for i, img in enumerate(images):
+        groups.setdefault(img.shape[:2], []).append(i)
+    return {
+        shape: (np.asarray(idx), np.stack([images[i] for i in idx]))
+        for shape, idx in groups.items()
+    }
+
+
+def grayscale(batch) -> jnp.ndarray:
+    """PixelScaler then GrayScaler -> [n, H, W] in [0, 1]."""
+    return GrayScaler()(PixelScaler()(jnp.asarray(batch)))[..., 0]
+
+
+def sample_columns(desc_buckets: dict, num_samples: int, seed: int = 42) -> jnp.ndarray:
+    """ColumnSampler analog over per-bucket [n, d, cols] descriptor arrays:
+    uniform sample of descriptor columns -> [d, <= num_samples].
+
+    Each bucket contributes its proportional quota and only the sampled
+    columns are materialized — never the full descriptor set (the reference
+    ColumnSampler likewise samples per image, Sampling.scala:12-22)."""
+    rng = np.random.default_rng(seed)
+    totals = {
+        shape: descs.shape[0] * descs.shape[2]
+        for shape, (_, descs) in desc_buckets.items()
+    }
+    grand_total = sum(totals.values())
+    if grand_total <= num_samples:
+        flats = [
+            jnp.moveaxis(descs, 1, 0).reshape(descs.shape[1], -1)
+            for _, descs in desc_buckets.values()
+        ]
+        return jnp.concatenate(flats, axis=1)
+    picks = []
+    for shape, (_, descs) in desc_buckets.items():
+        n, d, c = descs.shape
+        quota = min(totals[shape], max(1, int(num_samples * totals[shape] / grand_total)))
+        idx = np.sort(rng.choice(totals[shape], quota, replace=False))
+        flat = jnp.moveaxis(descs, 1, 0).reshape(d, n * c)
+        picks.append(flat[:, jnp.asarray(idx)])
+    return jnp.concatenate(picks, axis=1)
+
+
+def fisher_feature_pipeline(gmm: GaussianMixtureModel):
+    """FisherVector -> vectorize (col-major) -> L2 norm -> signed sqrt ->
+    L2 norm (reference constructFisherFeaturizer / VOCSIFTFisher.scala:73-80).
+    Returns a callable [n, d, cols]-descriptors -> [n, 2·d·K] features."""
+    fv = FisherVector(gmm)
+    vec = MatrixVectorizer()
+    norm = NormalizeRows()
+    hell = SignedHellingerMapper()
+
+    def featurize(descs):
+        return norm(hell(norm(vec(fv(descs)))))
+
+    return featurize
+
+
+def scatter_features(buckets: dict, transform, n_total: int, feature_dim: int) -> np.ndarray:
+    """Apply ``transform`` ([n, d, cols] descriptors -> [n, D] features) per
+    bucket and scatter rows back to original image order."""
+    out = np.zeros((n_total, feature_dim), np.float32)
+    for _shape, (idx, descs) in buckets.items():
+        out[np.asarray(idx)] = np.asarray(transform(descs))
+    return out

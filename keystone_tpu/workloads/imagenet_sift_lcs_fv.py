@@ -1,0 +1,247 @@
+"""ImageNetSiftLcsFV — the north-star workload: SIFT + LCS Fisher-vector
+features, 256k-dim class-weighted block solve, top-5 error
+(reference src/main/scala/pipelines/images/imagenet/ImageNetSiftLcsFV.scala:25-268).
+
+Per branch (SIFT / LCS):
+  featurize -> [SIFT: signed-sqrt] -> PCA(descDim) fit-or-load -> BatchPCA ->
+  GMM(vocabSize) fit-or-load -> FisherVector -> vectorize -> L2 -> signed-sqrt
+  -> L2.
+Branches are concatenated (ZipVectors) and solved with
+BlockWeightedLeastSquares(4096, 1, λ, w); evaluation is top-5 error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.logging import Logging, configure_logging
+from ..loaders.image_loaders import LabeledImages, imagenet_loader
+from ..ops.lcs import LCSExtractor
+from ..ops.sift import SIFTExtractor
+from ..ops.stats import SignedHellingerMapper
+from ..ops.util import ClassLabelIndicatorsFromIntLabels, TopKClassifier
+from ..solvers.gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
+from ..solvers.pca import BatchPCATransformer, compute_pca
+from ..solvers.weighted import BlockWeightedLeastSquaresEstimator
+from ..utils.stats import get_err_percent
+from .fv_common import (
+    bucket_by_shape,
+    fisher_feature_pipeline,
+    grayscale,
+    sample_columns,
+    scatter_features,
+)
+
+
+@dataclass
+class ImageNetSiftLcsFVConfig:
+    """Flag-parity with the reference scopt config (:195-224)."""
+
+    train_location: str = ""
+    test_location: str = ""
+    label_path: str = ""
+    lam: float = 6e-5
+    mixture_weight: float = 0.25
+    desc_dim: int = 64
+    vocab_size: int = 16
+    sift_scale_step: int = 1
+    lcs_stride: int = 4
+    lcs_border: int = 16
+    lcs_patch: int = 6
+    sift_pca_file: str | None = None
+    sift_gmm_mean_file: str | None = None
+    sift_gmm_var_file: str | None = None
+    sift_gmm_wts_file: str | None = None
+    lcs_pca_file: str | None = None
+    lcs_gmm_mean_file: str | None = None
+    lcs_gmm_var_file: str | None = None
+    lcs_gmm_wts_file: str | None = None
+    num_pca_samples: int = int(1e7)
+    num_gmm_samples: int = int(1e7)
+    num_classes: int = 1000
+    seed: int = 42
+
+
+class _Log(Logging):
+    pass
+
+
+def _fit_branch(conf: ImageNetSiftLcsFVConfig, desc_buckets: dict, pca_file, gmm_files, seed: int):
+    """Fit (or load) the branch's PCA + GMM from TRAIN descriptors only —
+    the reference fits once and applies the same featurizer to test
+    (ImageNetSiftLcsFV.scala:69,91,145).  Returns (batch_pca, fisher)."""
+    if pca_file is not None:
+        pca_mat = jnp.asarray(
+            np.loadtxt(pca_file, delimiter=",", ndmin=2).T, jnp.float32
+        )
+    else:
+        samples = sample_columns(desc_buckets, conf.num_pca_samples, seed)
+        pca_mat = compute_pca(samples.T, conf.desc_dim)
+    batch_pca = BatchPCATransformer(pca_mat)
+
+    mean_f, var_f, wts_f = gmm_files
+    if mean_f is not None:
+        gmm = GaussianMixtureModel.load(mean_f, var_f, wts_f)
+    else:
+        pca_desc = {
+            shape: (idx, batch_pca(descs))
+            for shape, (idx, descs) in desc_buckets.items()
+        }
+        gmm_samples = sample_columns(pca_desc, conf.num_gmm_samples, seed + 1)
+        gmm = GaussianMixtureModelEstimator(conf.vocab_size).fit(gmm_samples.T)
+
+    return batch_pca, fisher_feature_pipeline(gmm)
+
+
+def _apply_branch(desc_buckets: dict, batch_pca, fisher, n_images: int, feat_dim: int):
+    """Apply fitted PCA+Fisher to descriptor buckets, in original order."""
+    return scatter_features(
+        desc_buckets, lambda d: fisher(batch_pca(d)), n_images, feat_dim
+    )
+
+
+def sift_descriptor_buckets(conf: ImageNetSiftLcsFVConfig, images: list) -> dict:
+    """SIFT branch descriptors (:40-94): SIFT -> BatchSignedHellinger."""
+    sift = SIFTExtractor(scale_step=conf.sift_scale_step)
+    hell = SignedHellingerMapper()
+    buckets = {}
+    for shape, (idx, batch) in bucket_by_shape(images).items():
+        gray = grayscale(batch)
+        buckets[shape] = (idx, hell(sift(gray)))
+    return buckets
+
+
+def lcs_descriptor_buckets(conf: ImageNetSiftLcsFVConfig, images: list) -> dict:
+    """LCS branch descriptors (:96-148): raw LCS straight into PCA."""
+    lcs = LCSExtractor(conf.lcs_stride, conf.lcs_border, conf.lcs_patch)
+    return {
+        shape: (idx, lcs(jnp.asarray(batch)))
+        for shape, (idx, batch) in bucket_by_shape(images).items()
+    }
+
+
+def branch_features(
+    conf: ImageNetSiftLcsFVConfig,
+    train_images: list,
+    test_images: list,
+    descriptor_fn,
+    pca_file,
+    gmm_files,
+    seed: int,
+):
+    """Fit transformers on train, apply to train AND test."""
+    train_desc = descriptor_fn(conf, train_images)
+    batch_pca, fisher = _fit_branch(conf, train_desc, pca_file, gmm_files, seed)
+    feat_dim = 2 * conf.desc_dim * conf.vocab_size
+    train_feats = _apply_branch(train_desc, batch_pca, fisher, len(train_images), feat_dim)
+    test_desc = descriptor_fn(conf, test_images)
+    test_feats = _apply_branch(test_desc, batch_pca, fisher, len(test_images), feat_dim)
+    return train_feats, test_feats
+
+
+def run(conf: ImageNetSiftLcsFVConfig, train: LabeledImages, test: LabeledImages) -> dict:
+    configure_logging()
+    log = _Log()
+    t0 = time.perf_counter()
+
+    train_sift, test_sift = branch_features(
+        conf,
+        train.images,
+        test.images,
+        sift_descriptor_buckets,
+        conf.sift_pca_file,
+        (conf.sift_gmm_mean_file, conf.sift_gmm_var_file, conf.sift_gmm_wts_file),
+        conf.seed,
+    )
+    train_lcs, test_lcs = branch_features(
+        conf,
+        train.images,
+        test.images,
+        lcs_descriptor_buckets,
+        conf.lcs_pca_file,
+        (conf.lcs_gmm_mean_file, conf.lcs_gmm_var_file, conf.lcs_gmm_wts_file),
+        conf.seed + 100,
+    )
+
+    # ZipVectors (:179-183)
+    train_features = jnp.asarray(np.concatenate([train_sift, train_lcs], axis=1))
+    test_features = jnp.asarray(np.concatenate([test_sift, test_lcs], axis=1))
+
+    labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
+
+    # 2·2·descDim·vocabSize features (:186-188)
+    model = BlockWeightedLeastSquaresEstimator(
+        4096, 1, conf.lam, conf.mixture_weight
+    ).fit(train_features, labels, num_features=2 * 2 * conf.desc_dim * conf.vocab_size)
+
+    test_scores = model(test_features)
+    k = min(5, conf.num_classes)
+    topk = np.asarray(TopKClassifier(k)(test_scores))
+    err = get_err_percent(topk, test.labels, k)
+    results = {
+        "top5_err_percent": err,
+        "top1_err_percent": get_err_percent(topk, test.labels, 1),
+        "seconds": time.perf_counter() - t0,
+    }
+    log.log_info("TEST Top-%d error is: %s %%", k, err)
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("ImageNetSiftLcsFV")
+    p.add_argument("--trainLocation", required=True)
+    p.add_argument("--testLocation", required=True)
+    p.add_argument("--labelPath", required=True)
+    p.add_argument("--lambda", dest="lam", type=float, default=6e-5)
+    p.add_argument("--mixtureWeight", type=float, default=0.25)
+    p.add_argument("--descDim", type=int, default=64)
+    p.add_argument("--vocabSize", type=int, default=16)
+    p.add_argument("--siftScaleStep", type=int, default=1)
+    p.add_argument("--lcsStride", type=int, default=4)
+    p.add_argument("--lcsBorder", type=int, default=16)
+    p.add_argument("--lcsPatch", type=int, default=6)
+    p.add_argument("--numPcaSamples", type=int, default=int(1e7))
+    p.add_argument("--numGmmSamples", type=int, default=int(1e7))
+    p.add_argument("--numClasses", type=int, default=1000)
+    for flag in (
+        "siftPcaFile", "siftGmmMeanFile", "siftGmmVarFile", "siftGmmWtsFile",
+        "lcsPcaFile", "lcsGmmMeanFile", "lcsGmmVarFile", "lcsGmmWtsFile",
+    ):
+        p.add_argument(f"--{flag}", default=None)
+    a = p.parse_args(argv)
+    conf = ImageNetSiftLcsFVConfig(
+        train_location=a.trainLocation,
+        test_location=a.testLocation,
+        label_path=a.labelPath,
+        lam=a.lam,
+        mixture_weight=a.mixtureWeight,
+        desc_dim=a.descDim,
+        vocab_size=a.vocabSize,
+        sift_scale_step=a.siftScaleStep,
+        lcs_stride=a.lcsStride,
+        lcs_border=a.lcsBorder,
+        lcs_patch=a.lcsPatch,
+        sift_pca_file=a.siftPcaFile,
+        sift_gmm_mean_file=a.siftGmmMeanFile,
+        sift_gmm_var_file=a.siftGmmVarFile,
+        sift_gmm_wts_file=a.siftGmmWtsFile,
+        lcs_pca_file=a.lcsPcaFile,
+        lcs_gmm_mean_file=a.lcsGmmMeanFile,
+        lcs_gmm_var_file=a.lcsGmmVarFile,
+        lcs_gmm_wts_file=a.lcsGmmWtsFile,
+        num_pca_samples=a.numPcaSamples,
+        num_gmm_samples=a.numGmmSamples,
+        num_classes=a.numClasses,
+    )
+    train = imagenet_loader(conf.train_location, conf.label_path)
+    test = imagenet_loader(conf.test_location, conf.label_path)
+    return run(conf, train, test)
+
+
+if __name__ == "__main__":
+    main()

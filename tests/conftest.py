@@ -49,3 +49,7 @@ def mesh42(devices):
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running end-to-end pipeline test")
