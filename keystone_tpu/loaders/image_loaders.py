@@ -101,8 +101,10 @@ def voc_loader(data_path: str, labels_path: str, name_prefix: str = "VOCdevkit/V
     1-indexed in the file."""
     labels_map: dict[str, list[int]] = {}
     with open(labels_path) as fh:
-        next(fh)  # header
+        next(fh, None)  # header (empty file -> no rows)
         for line in fh:
+            if not line.strip():
+                continue
             parts = line.strip().split(",")
             fname = parts[4].replace('"', "")
             labels_map.setdefault(fname, []).append(int(parts[1]) - 1)

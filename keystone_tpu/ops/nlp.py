@@ -1,0 +1,122 @@
+"""NLP string/ngram nodes (reference src/main/scala/nodes/nlp/StringUtils.scala:13-31,
+ngrams.scala:18-183, TermFrequency at nodes/stats/TermFrequency.scala:18-20).
+
+These are host-side (strings never touch the TPU); batches are Python lists.
+The TPU enters downstream, once sparse features are vectorized (ops.sparse).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import defaultdict
+from typing import Callable, Sequence
+
+from ..core.pipeline import Transformer
+
+
+class Tokenizer(Transformer):
+    """Split on a regex, default all punctuation+whitespace
+    (reference StringUtils.scala:13-16).  Matches Scala ``String.split``
+    semantics: leading empty strings are kept, trailing removed."""
+
+    def __init__(self, sep: str = r"[^\w]+" "|_"):
+        # \p{Punct}+whitespace ~ non-word chars plus underscore in Python re
+        self.sep = sep
+        self._re = re.compile(sep)
+
+    def __call__(self, batch: Sequence[str]):
+        out = []
+        for line in batch:
+            toks = self._re.split(line)
+            while toks and toks[-1] == "":
+                toks.pop()
+            out.append(toks)
+        return out
+
+
+class Trim(Transformer):
+    """Strip leading/trailing whitespace (reference StringUtils.scala:21-23)."""
+
+    def __call__(self, batch: Sequence[str]):
+        return [s.strip() for s in batch]
+
+
+class LowerCase(Transformer):
+    """Lowercase (reference StringUtils.scala:29-31)."""
+
+    def __call__(self, batch: Sequence[str]):
+        return [s.lower() for s in batch]
+
+
+class NGramsFeaturizer(Transformer):
+    """All n-grams of consecutive orders [min..max]
+    (reference ngrams.scala:18-89).  Tokens -> list of tuples, emitted in the
+    reference's order: at each position, the min-order gram then its
+    extensions to max order."""
+
+    def __init__(self, orders: Sequence[int]):
+        orders = list(orders)
+        if min(orders) < 1:
+            raise ValueError(f"minimum order is not >= 1, found {min(orders)}")
+        for a, b in zip(orders, orders[1:]):
+            if b != a + 1:
+                raise ValueError(f"orders are not consecutive; contains {a} and {b}")
+        self.min_order = orders[0]
+        self.max_order = orders[-1]
+
+    def __call__(self, batch):
+        out = []
+        for tokens in batch:
+            grams = []
+            n = len(tokens)
+            for i in range(n - self.min_order + 1):
+                for order in range(
+                    self.min_order, min(self.max_order, n - i) + 1
+                ):
+                    grams.append(tuple(tokens[i : i + order]))
+            out.append(grams)
+        return out
+
+
+class TermFrequency(Transformer):
+    """Term counts with a weighting function applied to the raw count
+    (reference nodes/stats/TermFrequency.scala:18-20) — e.g. ``lambda x: 1``
+    for binary presence, identity for raw TF."""
+
+    def __init__(self, fn: Callable = lambda x: x):
+        self.fn = fn
+
+    def __call__(self, batch):
+        out = []
+        for terms in batch:
+            counts: dict = defaultdict(int)
+            for t in terms:
+                counts[t] += 1
+            out.append([(t, self.fn(c)) for t, c in counts.items()])
+        return out
+
+
+class WordFrequencyEncoder(Transformer):
+    """Fitted via :func:`word_frequency_encoder`: maps words to their
+    frequency rank (0 = most frequent), OOV -> -1
+    (reference nodes/nlp/WordFrequencyEncoder.scala:8-63)."""
+
+    def __init__(self, word_index: dict, unigram_counts: dict):
+        self.word_index = word_index
+        self.unigram_counts = unigram_counts
+
+    def __call__(self, batch):
+        wi = self.word_index
+        return [[wi.get(tok, -1) for tok in tokens] for tokens in batch]
+
+
+def fit_word_frequency_encoder(corpus) -> WordFrequencyEncoder:
+    """Rank words by corpus frequency (reference WordFrequencyEncoder:16-40)."""
+    counts: dict = defaultdict(int)
+    for tokens in corpus:
+        for t in tokens:
+            counts[t] += 1
+    ranked = sorted(counts.items(), key=lambda kv: -kv[1])
+    word_index = {w: i for i, (w, _) in enumerate(ranked)}
+    unigram_counts = {word_index[w]: c for w, c in counts.items()}
+    return WordFrequencyEncoder(word_index, unigram_counts)

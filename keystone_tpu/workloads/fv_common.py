@@ -49,19 +49,19 @@ def sample_columns(desc_buckets: dict, num_samples: int, seed: int = 42) -> jnp.
         for shape, (_, descs) in desc_buckets.items()
     }
     grand_total = sum(totals.values())
-    if grand_total <= num_samples:
-        flats = [
-            jnp.moveaxis(descs, 1, 0).reshape(descs.shape[1], -1)
-            for _, descs in desc_buckets.values()
-        ]
-        return jnp.concatenate(flats, axis=1)
     picks = []
     for shape, (_, descs) in desc_buckets.items():
         n, d, c = descs.shape
-        quota = min(totals[shape], max(1, int(num_samples * totals[shape] / grand_total)))
-        idx = np.sort(rng.choice(totals[shape], quota, replace=False))
-        flat = jnp.moveaxis(descs, 1, 0).reshape(d, n * c)
-        picks.append(flat[:, jnp.asarray(idx)])
+        total = totals[shape]
+        if grand_total <= num_samples:
+            quota = total
+            idx = np.arange(total)
+        else:
+            quota = min(total, max(1, int(num_samples * total / grand_total)))
+            idx = np.sort(rng.choice(total, quota, replace=False))
+        # gather the quota columns directly — no transposed full copy
+        im, col = np.divmod(idx, c)
+        picks.append(descs[jnp.asarray(im), :, jnp.asarray(col)].T)  # [d, quota]
     return jnp.concatenate(picks, axis=1)
 
 

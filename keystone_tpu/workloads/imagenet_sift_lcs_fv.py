@@ -74,7 +74,10 @@ class _Log(Logging):
 def _fit_branch(conf: ImageNetSiftLcsFVConfig, desc_buckets: dict, pca_file, gmm_files, seed: int):
     """Fit (or load) the branch's PCA + GMM from TRAIN descriptors only —
     the reference fits once and applies the same featurizer to test
-    (ImageNetSiftLcsFV.scala:69,91,145).  Returns (batch_pca, fisher)."""
+    (ImageNetSiftLcsFV.scala:69,91,145).
+
+    Returns (batch_pca, fisher, train_pca_desc): the PCA-projected train
+    buckets are returned so callers never re-project the training set."""
     if pca_file is not None:
         pca_mat = jnp.asarray(
             np.loadtxt(pca_file, delimiter=",", ndmin=2).T, jnp.float32
@@ -84,25 +87,19 @@ def _fit_branch(conf: ImageNetSiftLcsFVConfig, desc_buckets: dict, pca_file, gmm
         pca_mat = compute_pca(samples.T, conf.desc_dim)
     batch_pca = BatchPCATransformer(pca_mat)
 
+    pca_desc = {
+        shape: (idx, batch_pca(descs))
+        for shape, (idx, descs) in desc_buckets.items()
+    }
+
     mean_f, var_f, wts_f = gmm_files
     if mean_f is not None:
         gmm = GaussianMixtureModel.load(mean_f, var_f, wts_f)
     else:
-        pca_desc = {
-            shape: (idx, batch_pca(descs))
-            for shape, (idx, descs) in desc_buckets.items()
-        }
         gmm_samples = sample_columns(pca_desc, conf.num_gmm_samples, seed + 1)
         gmm = GaussianMixtureModelEstimator(conf.vocab_size).fit(gmm_samples.T)
 
-    return batch_pca, fisher_feature_pipeline(gmm)
-
-
-def _apply_branch(desc_buckets: dict, batch_pca, fisher, n_images: int, feat_dim: int):
-    """Apply fitted PCA+Fisher to descriptor buckets, in original order."""
-    return scatter_features(
-        desc_buckets, lambda d: fisher(batch_pca(d)), n_images, feat_dim
-    )
+    return batch_pca, fisher_feature_pipeline(gmm), pca_desc
 
 
 def sift_descriptor_buckets(conf: ImageNetSiftLcsFVConfig, images: list) -> dict:
@@ -136,11 +133,17 @@ def branch_features(
 ):
     """Fit transformers on train, apply to train AND test."""
     train_desc = descriptor_fn(conf, train_images)
-    batch_pca, fisher = _fit_branch(conf, train_desc, pca_file, gmm_files, seed)
+    batch_pca, fisher, train_pca_desc = _fit_branch(
+        conf, train_desc, pca_file, gmm_files, seed
+    )
     feat_dim = 2 * conf.desc_dim * conf.vocab_size
-    train_feats = _apply_branch(train_desc, batch_pca, fisher, len(train_images), feat_dim)
+    train_feats = scatter_features(
+        train_pca_desc, fisher, len(train_images), feat_dim
+    )
     test_desc = descriptor_fn(conf, test_images)
-    test_feats = _apply_branch(test_desc, batch_pca, fisher, len(test_images), feat_dim)
+    test_feats = scatter_features(
+        test_desc, lambda d: fisher(batch_pca(d)), len(test_images), feat_dim
+    )
     return train_feats, test_feats
 
 

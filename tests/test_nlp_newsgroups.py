@@ -1,0 +1,159 @@
+"""NLP node + NaiveBayes + NewsgroupsPipeline tests (reference
+src/test/scala/nodes/nlp/*, NaiveBayesModelSuite criteria, and an e2e run
+on a synthetic 20-newsgroups-format directory)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders.newsgroups import newsgroups_loader
+from keystone_tpu.ops.nlp import (
+    LowerCase,
+    NGramsFeaturizer,
+    TermFrequency,
+    Tokenizer,
+    Trim,
+    fit_word_frequency_encoder,
+)
+from keystone_tpu.ops.sparse import (
+    AllSparseFeatures,
+    CommonSparseFeatures,
+    SparseFeatureVectorizer,
+)
+from keystone_tpu.solvers.naive_bayes import NaiveBayesEstimator
+from keystone_tpu.workloads.newsgroups import NewsgroupsConfig, run
+from keystone_tpu.loaders.newsgroups import NewsgroupsData
+
+
+class TestStringNodes:
+    def test_trim_lower_tokenize(self):
+        out = Tokenizer()(LowerCase()(Trim()(["  Hello, World!  "])))
+        assert out == [["hello", "world"]]
+
+    def test_tokenizer_keeps_leading_empty(self):
+        # Scala split keeps a leading empty string when the line starts
+        # with a separator ("a,b".split -> ["a","b"], ",a" -> ["", "a"])
+        assert Tokenizer()([",a b"]) == [["", "a", "b"]]
+        assert Tokenizer()(["a b,"]) == [["a", "b"]]
+
+
+class TestNGrams:
+    def test_orders_1_to_3(self):
+        # reference NGramsFeaturizerSuite-style: all 1..3-grams in order
+        out = NGramsFeaturizer(range(1, 4))([["a", "b", "c"]])[0]
+        assert ("a",) in out and ("a", "b") in out and ("a", "b", "c") in out
+        assert ("b", "c") in out and ("c",) in out
+        assert len(out) == 6
+
+    def test_non_consecutive_orders_rejected(self):
+        with pytest.raises(ValueError, match="consecutive"):
+            NGramsFeaturizer([1, 3])
+
+    def test_emission_order_matches_reference(self):
+        # at each position: min-order gram, then extensions
+        out = NGramsFeaturizer([1, 2])([["x", "y", "z"]])[0]
+        assert out == [("x",), ("x", "y"), ("y",), ("y", "z"), ("z",)]
+
+
+class TestTermFrequencySparse:
+    def test_term_frequency_weighting(self):
+        out = TermFrequency(lambda x: x * 10)([["a", "a", "b"]])[0]
+        assert dict(out) == {"a": 20, "b": 10}
+
+    def test_common_sparse_features_top_k(self):
+        docs = [[("a", 1.0), ("b", 1.0)], [("a", 1.0)], [("a", 1.0), ("c", 1.0)]]
+        vec = CommonSparseFeatures(2).fit(docs)
+        assert "a" in vec.feature_space and len(vec.feature_space) == 2
+        csr = vec(docs)
+        assert csr.shape == (3, 2)
+        dense = csr.to_dense()
+        assert dense[:, vec.feature_space["a"]].tolist() == [1.0, 1.0, 1.0]
+
+    def test_all_sparse_features(self):
+        docs = [[("x", 2.0)], [("y", 3.0)]]
+        vec = AllSparseFeatures().fit(docs)
+        assert vec(docs).shape == (2, 2)
+
+    def test_unseen_features_dropped(self):
+        vec = SparseFeatureVectorizer({"a": 0})
+        csr = vec([[("zzz", 5.0), ("a", 1.0)]])
+        assert csr.to_dense().tolist() == [[1.0]]
+
+
+class TestNaiveBayes:
+    def test_matches_closed_form(self, rng):
+        # hand-computable smoothed counts (MLlib semantics)
+        feats = np.array([[2.0, 0.0], [1.0, 1.0], [0.0, 3.0]])
+        labels = np.array([0, 0, 1])
+        model = NaiveBayesEstimator(2, lam=1.0).fit(feats, labels)
+        pi = np.asarray(model.pi)
+        theta = np.asarray(model.theta)
+        np.testing.assert_allclose(
+            pi, [np.log(3 / 5), np.log(2 / 5)], atol=1e-6
+        )
+        # class 0 counts: [3, 1]; theta[0] = log((c+1)/(4+2))
+        np.testing.assert_allclose(
+            theta[0], [np.log(4 / 6), np.log(2 / 6)], atol=1e-6
+        )
+
+    def test_csr_and_dense_agree(self, rng):
+        from keystone_tpu.ops.sparse import AllSparseFeatures
+
+        docs = [
+            [("a", 2.0), ("b", 1.0)],
+            [("b", 3.0)],
+            [("a", 1.0), ("c", 2.0)],
+        ]
+        labels = np.array([0, 1, 0])
+        vec = AllSparseFeatures().fit(docs)
+        csr = vec(docs)
+        model = NaiveBayesEstimator(2).fit(csr, labels)
+        dense_scores = np.asarray(model(csr.to_dense()))
+        csr_scores = np.asarray(model(csr))
+        np.testing.assert_allclose(dense_scores, csr_scores, atol=1e-4)
+
+    def test_learns_separable_text(self, rng):
+        n = 60
+        vocab_a = ["apple", "orange", "banana"]
+        vocab_b = ["engine", "wheel", "brake"]
+        docs, labels = [], []
+        for i in range(n):
+            c = i % 2
+            words = rng.choice(vocab_a if c == 0 else vocab_b, 20).tolist()
+            words += rng.choice(vocab_a + vocab_b, 3).tolist()  # noise
+            docs.append([(w, 1.0) for w in set(words)])
+            labels.append(c)
+        vec = AllSparseFeatures().fit(docs)
+        model = NaiveBayesEstimator(2).fit(vec(docs), np.array(labels))
+        pred = np.argmax(np.asarray(model(vec(docs))), axis=1)
+        assert (pred == np.array(labels)).mean() > 0.95
+
+
+class TestWordFrequencyEncoder:
+    def test_rank_and_oov(self):
+        enc = fit_word_frequency_encoder([["a", "a", "b"], ["a", "c", "b"]])
+        assert enc.word_index["a"] == 0
+        out = enc([["a", "zzz", "b"]])
+        assert out == [[0, -1, enc.word_index["b"]]]
+
+
+class TestNewsgroupsE2E:
+    def test_pipeline_classifies_synthetic_groups(self, tmp_path, rng):
+        themes = {
+            "comp.graphics": ["pixel", "render", "shader", "gpu", "image"],
+            "rec.autos": ["engine", "car", "wheel", "drive", "motor"],
+            "sci.space": ["orbit", "rocket", "nasa", "launch", "moon"],
+        }
+        for split in ("train", "test"):
+            for cls, words in themes.items():
+                d = tmp_path / split / cls
+                d.mkdir(parents=True)
+                for i in range(20 if split == "train" else 8):
+                    body = " ".join(rng.choice(words, 30).tolist())
+                    noise = " ".join(rng.choice(["the", "and", "is"], 10).tolist())
+                    (d / f"doc{i}.txt").write_text(f"{body} {noise}")
+        classes = tuple(themes)
+        train = newsgroups_loader(str(tmp_path / "train"), list(classes))
+        test = newsgroups_loader(str(tmp_path / "test"), list(classes))
+        conf = NewsgroupsConfig(n_grams=2, common_features=5000, classes=classes)
+        results = run(conf, train, test)
+        assert results["test_error"] < 5.0, results["test_error"]

@@ -1,0 +1,48 @@
+"""TIMIT loader + pipeline e2e on synthetic separable phone data."""
+
+import numpy as np
+
+from keystone_tpu.loaders.timit import TimitFeaturesData, TimitSplit, timit_features_loader
+from keystone_tpu.workloads.timit import TimitConfig, run
+
+
+def write_split(tmp_path, name, n, rng, centers):
+    k, d = centers.shape
+    labels = rng.integers(0, k, n)
+    data = centers[labels] + 0.4 * rng.normal(size=(n, d))
+    data_path = tmp_path / f"{name}.csv"
+    labels_path = tmp_path / f"{name}.labels"
+    np.savetxt(data_path, data, delimiter=",", fmt="%.5f")
+    with open(labels_path, "w") as fh:
+        for i, l in enumerate(labels):
+            fh.write(f"{i + 1} {l + 1}\n")  # 1-indexed rows and labels
+    return str(data_path), str(labels_path), labels
+
+
+class TestTimitLoader:
+    def test_roundtrip(self, tmp_path, rng):
+        centers = rng.normal(size=(5, 8))
+        dp, lp, labels = write_split(tmp_path, "train", 20, rng, centers)
+        data = timit_features_loader(dp, lp, dp, lp)
+        assert data.train.data.shape == (20, 8)
+        np.testing.assert_array_equal(data.train.labels, labels)
+
+
+class TestTimitPipelineE2E:
+    def test_learns_synthetic_phones(self, tmp_path, rng):
+        d, k = 24, 6
+        centers = rng.normal(scale=2.0, size=(k, d))
+        tdp, tlp, _ = write_split(tmp_path, "train", 300, rng, centers)
+        sdp, slp, _ = write_split(tmp_path, "test", 100, rng, centers)
+        data = timit_features_loader(tdp, tlp, sdp, slp)
+        conf = TimitConfig(
+            num_cosines=3,
+            num_cosine_features=128,
+            num_epochs=2,
+            gamma=0.2,
+            lam=1e-3,
+            num_classes=k,
+            dimension=d,
+        )
+        results = run(conf, data)
+        assert results["test_error"] < 10.0, results
