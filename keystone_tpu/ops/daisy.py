@@ -1,0 +1,138 @@
+"""DAISY dense descriptors (reference src/main/scala/nodes/images/DaisyExtractor.scala:28-201;
+Tola, Lepetit, Fua — PAMI 2010).
+
+Oriented gradient maps via separable [1,0,-1]x[1,2,1] convolutions, a cascade
+of Gaussian blur layers, ring sampling of histograms, per-histogram L2
+normalization with a zero threshold.  All convolutions/orientation maps are
+batched XLA ops; the ring sampling is one static gather.
+
+Output per image: ``[num_keypoints, daisyH*(daisyT*daisyQ + 1)]`` — DAISY
+descriptors are ROWS (the reference's DenseMatrix layout), unlike the
+SIFT/LCS column convention.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.pipeline import Transformer, node
+from .lcs import _same_conv2d_zero
+
+FEATURE_THRESHOLD = 1e-8  # zero histograms below this norm
+CONV_THRESHOLD = 1e-6  # where to truncate the Gaussian blurs
+
+
+@node(
+    meta_fields=(
+        "daisy_t", "daisy_q", "daisy_r", "daisy_h",
+        "pixel_border", "stride", "patch_size",
+    )
+)
+class DaisyExtractor(Transformer):
+    """Batched DAISY: ``[N, H, W, 1]`` (or [N,H,W]) -> ``[N, K, featSize]``."""
+
+    def __init__(
+        self,
+        daisy_t: int = 8,
+        daisy_q: int = 3,
+        daisy_r: int = 7,
+        daisy_h: int = 8,
+        pixel_border: int = 16,
+        stride: int = 4,
+        patch_size: int = 24,
+    ):
+        self.daisy_t = daisy_t
+        self.daisy_q = daisy_q
+        self.daisy_r = daisy_r
+        self.daisy_h = daisy_h
+        self.pixel_border = pixel_border
+        self.stride = stride
+        self.patch_size = patch_size
+
+    @property
+    def feature_size(self) -> int:
+        return self.daisy_h * (self.daisy_t * self.daisy_q + 1)
+
+    def _gaussians(self):
+        """Blur kernels g[q] from the sigma-difference cascade (:50-64)."""
+        q_range = np.arange(self.daisy_q + 1)
+        sigma_sq = (self.daisy_r * q_range / (2.0 * self.daisy_q)) ** 2
+        diff = sigma_sq[1:] - sigma_sq[:-1]
+        kernels = []
+        for t in diff:
+            rad = int(
+                math.ceil(
+                    math.sqrt(-2 * t * math.log(CONV_THRESHOLD) - t * math.log(2 * math.pi * t))
+                )
+            )
+            n = np.arange(-rad, rad + 1, dtype=np.float64)
+            k = np.exp(-(n**2) / (2.0 * t)) / math.sqrt(2 * math.pi * t)
+            kernels.append(k.astype(np.float32))
+        return kernels
+
+    def _keypoints(self, dim: int) -> np.ndarray:
+        return np.arange(self.pixel_border, dim - self.pixel_border, self.stride)
+
+    def __call__(self, batch):
+        if batch.ndim == 3:
+            batch = batch[..., None]
+        n, h, w, _ = batch.shape
+        f1 = np.array([1.0, 0.0, -1.0], np.float32)
+        f2 = np.array([1.0, 2.0, 1.0], np.float32)
+        # gradients (:111-113): conv2D(in, filter1, filter2) = d/dx smoothed
+        ix = _same_conv2d_zero(batch, f1, f2)[..., 0]
+        iy = _same_conv2d_zero(batch, f2, f1)[..., 0]
+
+        kernels = self._gaussians()
+        # orientation maps: max(cos(a)·ix + sin(a)·iy, 0), blur cascade (:116-137)
+        angles = 2.0 * np.pi * np.arange(self.daisy_h) / self.daisy_h
+        layers = []  # layers[q] : [N, daisyH, H, W]
+        per_angle = []
+        for a in angles:
+            m = jnp.maximum(math.cos(a) * ix + math.sin(a) * iy, 0.0)
+            per_angle.append(m)
+        current = jnp.stack(per_angle, axis=1)  # [N, daisyH, H, W]
+        for q in range(self.daisy_q):
+            g = kernels[q]
+            flat = current.reshape(n * self.daisy_h, h, w)[..., None]
+            blurred = _same_conv2d_zero(flat, g, g)[..., 0]
+            current = blurred.reshape(n, self.daisy_h, h, w)
+            layers.append(current)
+
+        xs = self._keypoints(w)
+        ys = self._keypoints(h)
+        n_x, n_y = len(xs), len(ys)
+        # keypoint grid flattened as x*numY + y (:151-199)
+        kp_x = np.repeat(xs, n_y)
+        kp_y = np.tile(ys, n_x)
+
+        def normalize(hists):
+            # [..., daisyH] L2 normalize; zero when norm <= threshold (:193-200)
+            norm = jnp.linalg.norm(hists, axis=-1, keepdims=True)
+            return jnp.where(norm > FEATURE_THRESHOLD, hists / norm, 0.0)
+
+        # center histogram from layer 0 at the keypoint (:96-103)
+        center = layers[0][:, :, jnp.asarray(kp_y), jnp.asarray(kp_x)]  # [N, daisyH, K]
+        center = normalize(jnp.moveaxis(center, 1, 2))  # [N, K, daisyH]
+
+        out = jnp.zeros((n, n_x * n_y, self.feature_size), center.dtype)
+        out = out.at[:, :, : self.daisy_h].set(center)
+
+        # ring histograms (:73-94, :165-186): layout column
+        # daisyH + angle*Q*H + level*H + off
+        for level in range(self.daisy_q):
+            cur_rad = self.daisy_r * (1.0 + level) / self.daisy_q
+            for angle_count in range(self.daisy_t):
+                cur_theta = 2.0 * math.pi * (angle_count - 1) / self.daisy_t
+                off_x = int(round(cur_rad * math.sin(cur_theta)))
+                off_y = int(round(cur_rad * math.cos(cur_theta)))
+                sx = np.clip(kp_x + off_x, 0, w - 1)
+                sy = np.clip(kp_y + off_y, 0, h - 1)
+                hist = layers[level][:, :, jnp.asarray(sy), jnp.asarray(sx)]
+                hist = normalize(jnp.moveaxis(hist, 1, 2))  # [N, K, daisyH]
+                col0 = self.daisy_h + angle_count * self.daisy_q * self.daisy_h + level * self.daisy_h
+                out = out.at[:, :, col0 : col0 + self.daisy_h].set(hist)
+        return out

@@ -1,0 +1,155 @@
+"""Histogram of Oriented Gradients, Felzenszwalb/voc-dpm variant
+(reference src/main/scala/nodes/images/HogExtractor.scala:33-296, itself a
+port of voc-dpm features.cc).
+
+31-dim cell features: 18 contrast-sensitive + 9 contrast-insensitive
+orientation channels (block-normalized by 4 neighborhoods, clamped at 0.2),
+4 texture-energy features, 1 truncation feature (always 0).
+
+The reference walks pixels in Scala while-loops; here the per-pixel work
+(channel selection, orientation snapping, bilinear cell weights) is batched
+array ops and the histogram is built with 4 scatter-adds.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.pipeline import Transformer, node
+
+EPSILON = 0.0001
+UU = np.array(
+    [1.0, 0.9397, 0.7660, 0.5, 0.1736, -0.1736, -0.5, -0.7660, -0.9397]
+)
+VV = np.array(
+    [0.0, 0.3420, 0.6428, 0.8660, 0.9848, 0.9848, 0.8660, 0.6428, 0.3420]
+)
+
+
+@node(meta_fields=("bin_size",))
+class HogExtractor(Transformer):
+    """Batched HOG: ``[N, H, W, C]`` -> ``[N, cells, 32]`` where
+    cells = max(numXCells-2,0)·max(numYCells-2,0) and the 32nd column is the
+    truncation feature (reference computeFeaturesFromHist :196-296)."""
+
+    def __init__(self, bin_size: int):
+        self.bin_size = bin_size
+
+    def __call__(self, batch):
+        n, h, w, c = batch.shape
+        bs = self.bin_size
+        # reference x = column axis (xDim), y = row axis
+        nx = int(round(w / bs))
+        ny = int(round(h / bs))
+        vis_x = nx * bs
+        vis_y = ny * bs
+
+        # interior visible pixels [1, vis-1).  When a dimension rounds UP
+        # (dim mod bin_size > bin_size/2) the visible region exceeds the
+        # image; voc-dpm clamps gradient reads to the image interior
+        # (features.cc: min(x, dims-2)) while bin positions use the
+        # unclamped coordinate — the Scala port would crash there.
+        px = np.arange(1, vis_x - 1)
+        py = np.arange(1, vis_y - 1)
+        # central differences over the full interior, then gather at clamped
+        # coordinates
+        dxi = (batch[:, :, 2:, :] - batch[:, :, :-2, :])[:, 1:-1, :, :]  # [N,h-2,w-2,C]
+        dyi = (batch[:, 2:, :, :] - batch[:, :-2, :, :])[:, :, 1:-1, :]
+        px_r = np.minimum(px, w - 2) - 1
+        py_r = np.minimum(py, h - 2) - 1
+        dx_all = dxi[:, py_r][:, :, px_r]  # [N,py,px,C]
+        dy_all = dyi[:, py_r][:, :, px_r]
+        mag2 = dx_all * dx_all + dy_all * dy_all
+        # channel loop runs 2,1,0 with strict '>': ties keep the HIGHEST
+        # channel index; argmax on the reversed axis replicates that
+        best_rev = jnp.argmax(mag2[..., ::-1], axis=-1)
+        best_c = (c - 1) - best_rev
+        dx = jnp.take_along_axis(dx_all, best_c[..., None], axis=-1)[..., 0]
+        dy = jnp.take_along_axis(dy_all, best_c[..., None], axis=-1)[..., 0]
+        mag = jnp.sqrt(jnp.take_along_axis(mag2, best_c[..., None], axis=-1)[..., 0])
+
+        # orientation snap (:118-133): candidates interleaved (+d0,-d0,+d1,..)
+        uu = jnp.asarray(UU, batch.dtype)
+        vv = jnp.asarray(VV, batch.dtype)
+        dots = dy[..., None] * uu + dx[..., None] * vv  # [N,py,px,9]
+        cand = jnp.stack([dots, -dots], axis=-1).reshape(*dots.shape[:-1], 18)
+        best_i = jnp.argmax(cand, axis=-1)
+        orient = jnp.where(best_i % 2 == 0, best_i // 2, best_i // 2 + 9)
+        # initial best dot is 0.0: all-zero gradients give orientation 0
+        orient = jnp.where(jnp.max(cand, axis=-1) > 0.0, orient, 0)
+
+        # bilinear cell weights — functions of pixel coords only (:136-160)
+        xp = (px + 0.5) / bs - 0.5
+        yp = (py + 0.5) / bs - 0.5
+        ixp = np.floor(xp).astype(np.int64)
+        iyp = np.floor(yp).astype(np.int64)
+        vx0 = xp - ixp
+        vy0 = yp - iyp
+
+        hist = jnp.zeros((n, 18 * nx * ny), batch.dtype)
+        flat_o = orient * (nx * ny)
+        iyp_g, ixp_g = np.meshgrid(iyp, ixp, indexing="ij")
+        vy0_g, vx0_g = np.meshgrid(vy0, vx0, indexing="ij")
+        for dy_c, dx_c, wgt in (
+            (0, 0, (1 - vy0_g) * (1 - vx0_g)),
+            (1, 0, vy0_g * (1 - vx0_g)),
+            (0, 1, (1 - vy0_g) * vx0_g),
+            (1, 1, vy0_g * vx0_g),
+        ):
+            cx = ixp_g + dx_c
+            cy = iyp_g + dy_c
+            valid = (cx >= 0) & (cy >= 0) & (cx < nx) & (cy < ny)
+            cell = np.clip(cx, 0, nx - 1) + np.clip(cy, 0, ny - 1) * nx
+            idx = flat_o + jnp.asarray(cell)
+            contrib = mag * jnp.asarray(wgt * valid, batch.dtype)
+            hist = hist.at[
+                jnp.arange(n)[:, None, None], idx
+            ].add(contrib)
+        hist = hist.reshape(n, 18, ny, nx)
+
+        # block energies (:167-193): opposite orientations combined
+        norm = jnp.sum(
+            (hist[:, :9] + hist[:, 9:]) ** 2, axis=1
+        )  # [N, ny, nx]
+
+        nxf, nyf = max(nx - 2, 0), max(ny - 2, 0)
+        if nxf == 0 or nyf == 0:
+            return jnp.zeros((n, 0, 32), batch.dtype)
+
+        def block_norm(y0, x0):
+            # 1/sqrt of 2x2 neighborhood energy starting at (y0, x0)
+            s = (
+                norm[:, y0 : y0 + nyf, x0 : x0 + nxf]
+                + norm[:, y0 : y0 + nyf, x0 + 1 : x0 + 1 + nxf]
+                + norm[:, y0 + 1 : y0 + 1 + nyf, x0 : x0 + nxf]
+                + norm[:, y0 + 1 : y0 + 1 + nyf, x0 + 1 : x0 + 1 + nxf]
+            )
+            return 1.0 / jnp.sqrt(s + EPSILON)
+
+        n1 = block_norm(1, 1)
+        n2 = block_norm(1, 0)
+        n3 = block_norm(0, 1)
+        n4 = block_norm(0, 0)  # each [N, nyf, nxf]
+
+        center = hist[:, :, 1 : 1 + nyf, 1 : 1 + nxf]  # [N, 18, nyf, nxf]
+        feats = []
+        tsum = [jnp.zeros_like(n1) for _ in range(4)]
+        for o in range(18):
+            hs = [
+                jnp.minimum(center[:, o] * nk, 0.2) for nk in (n1, n2, n3, n4)
+            ]
+            for i in range(4):
+                tsum[i] = tsum[i] + hs[i]
+            feats.append(0.5 * (hs[0] + hs[1] + hs[2] + hs[3]))
+        for o in range(9):
+            s = center[:, o] + center[:, o + 9]
+            hs = [jnp.minimum(s * nk, 0.2) for nk in (n1, n2, n3, n4)]
+            feats.append(0.5 * (hs[0] + hs[1] + hs[2] + hs[3]))
+        for i in range(4):
+            feats.append(0.2357 * tsum[i])
+        feats.append(jnp.zeros_like(n1))  # truncation feature
+        stacked = jnp.stack(feats, axis=-1)  # [N, nyf, nxf, 32]
+        # row index = y + x*numYCellsWithFeatures (:210) -> x-major flatten
+        stacked = jnp.swapaxes(stacked, 1, 2)  # [N, nxf, nyf, 32]
+        return stacked.reshape(n, nxf * nyf, 32)

@@ -1,0 +1,204 @@
+"""DAISY / HOG tests: naive-loop transcriptions of the reference Scala code
+(DaisyExtractorSuite/HogExtractorSuite analogs) as oracles, plus structural
+invariants."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.daisy import DaisyExtractor
+from keystone_tpu.ops.hog import HogExtractor
+from keystone_tpu.utils.stats import about_eq
+
+
+def conv2d_same(img, xfilt, yfilt):
+    """Reference ImageUtils.conv2D: zero pad (len-1) split floor/ceil, true
+    convolution, same output size.  img [H, W]."""
+    h, w = img.shape
+    xl, yl = len(xfilt), len(yfilt)
+    ph_lo = (yl - 1) // 2
+    pw_lo = (xl - 1) // 2
+    padded = np.zeros((h + yl - 1, w + xl - 1))
+    padded[ph_lo : ph_lo + h, pw_lo : pw_lo + w] = img
+    xr, yr = xfilt[::-1], yfilt[::-1]
+    mid = np.zeros((h, w + xl - 1))
+    for y in range(h):
+        for x in range(w + xl - 1):
+            mid[y, x] = sum(padded[y + i, x] * yr[i] for i in range(yl))
+    out = np.zeros((h, w))
+    for y in range(h):
+        for x in range(w):
+            out[y, x] = sum(mid[y, x + i] * xr[i] for i in range(xl))
+    return out
+
+
+def naive_daisy(img, ext: DaisyExtractor):
+    """Transcription of DaisyExtractor.apply (:106-191) on [H, W]."""
+    h, w = img.shape
+    T, Q, R, H = ext.daisy_t, ext.daisy_q, ext.daisy_r, ext.daisy_h
+    f1, f2 = [1.0, 0.0, -1.0], [1.0, 2.0, 1.0]
+    ix = conv2d_same(img, f1, f2)
+    iy = conv2d_same(img, f2, f1)
+
+    sigma_sq = [(R * q / (2 * Q)) ** 2 for q in range(Q + 1)]
+    diff = [b - a for a, b in zip(sigma_sq, sigma_sq[1:])]
+    gs = []
+    for t in diff:
+        rad = int(math.ceil(math.sqrt(-2 * t * math.log(1e-6) - t * math.log(2 * math.pi * t))))
+        ns = np.arange(-rad, rad + 1)
+        gs.append(np.exp(-(ns**2) / (2 * t)) / math.sqrt(2 * math.pi * t))
+
+    layers = [[None] * H for _ in range(Q)]
+    for a_i in range(H):
+        ang = 2 * math.pi * a_i / H
+        m = np.maximum(math.cos(ang) * ix + math.sin(ang) * iy, 0.0)
+        layers[0][a_i] = conv2d_same(m, gs[0], gs[0])
+        for l in range(1, Q):
+            layers[l][a_i] = conv2d_same(layers[l - 1][a_i], gs[l], gs[l])
+
+    def norm_hist(v):
+        nv = np.linalg.norm(v)
+        return v / nv if nv > 1e-8 else np.zeros_like(v)
+
+    xs = list(range(ext.pixel_border, w - ext.pixel_border, ext.stride))
+    ys = list(range(ext.pixel_border, h - ext.pixel_border, ext.stride))
+    out = np.zeros((len(xs) * len(ys), ext.feature_size), np.float64)
+    for i, x in enumerate(xs):
+        for j, y in enumerate(ys):
+            row = i * len(ys) + j
+            center = norm_hist(np.array([layers[0][hh][y, x] for hh in range(H)]))
+            out[row, :H] = center
+            for l in range(Q):
+                rad = R * (1.0 + l) / Q
+                for ac in range(T):
+                    th = 2 * math.pi * (ac - 1) / T
+                    sx = x + int(round(rad * math.sin(th)))
+                    sy = y + int(round(rad * math.cos(th)))
+                    hist = norm_hist(np.array([layers[l][hh][sy, sx] for hh in range(H)]))
+                    col0 = H + ac * Q * H + l * H
+                    out[row, col0 : col0 + H] = hist
+    return out
+
+
+def naive_hog(img, bin_size):
+    """Transcription of HogExtractor (:65-296) on [H, W, C] (x = col)."""
+    h, w, c = img.shape
+    nx, ny = round(w / bin_size), round(h / bin_size)
+    hist = np.zeros(nx * ny * 18)
+    for x in range(1, nx * bin_size - 1):
+        for y in range(1, ny * bin_size - 1):
+            best = (-np.inf, None, None)
+            for ch in (2, 1, 0):
+                dx = img[y, x + 1, ch] - img[y, x - 1, ch]
+                dy = img[y + 1, x, ch] - img[y - 1, x, ch]
+                m2 = dx * dx + dy * dy
+                if m2 > best[0]:
+                    best = (m2, dx, dy)
+            m2, dx, dy = best
+            mag = math.sqrt(m2)
+            from keystone_tpu.ops.hog import UU, VV
+
+            bd, bi = 0.0, 0
+            for o in range(9):
+                dot = UU[o] * dy + VV[o] * dx
+                if dot > bd:
+                    bd, bi = dot, o
+                elif -dot > bd:
+                    bd, bi = -dot, o + 9
+            yp = (y + 0.5) / bin_size - 0.5
+            xp = (x + 0.5) / bin_size - 0.5
+            iyp, ixp = math.floor(yp), math.floor(xp)
+            vy0, vx0 = yp - iyp, xp - ixp
+            for (cy, cx, wgt) in (
+                (iyp, ixp, (1 - vy0) * (1 - vx0)),
+                (iyp + 1, ixp, vy0 * (1 - vx0)),
+                (iyp, ixp + 1, (1 - vy0) * vx0),
+                (iyp + 1, ixp + 1, vy0 * vx0),
+            ):
+                if 0 <= cx < nx and 0 <= cy < ny:
+                    hist[cx + cy * nx + bi * nx * ny] += wgt * mag
+    norm = np.zeros(nx * ny)
+    for o in range(9):
+        for y in range(ny):
+            for x in range(nx):
+                v = hist[x + y * nx + o * nx * ny] + hist[x + y * nx + (o + 9) * nx * ny]
+                norm[x + y * nx] += v * v
+    nxf, nyf = max(nx - 2, 0), max(ny - 2, 0)
+    feats = np.zeros((nxf * nyf, 32))
+    for x in range(nxf):
+        for y in range(nyf):
+            row = y + x * nyf
+
+            def bn(y0, x0):
+                off = y0 * nx + x0
+                return 1.0 / math.sqrt(
+                    norm[off] + norm[off + 1] + norm[off + nx] + norm[off + nx + 1] + 0.0001
+                )
+
+            n1, n2, n3, n4 = bn(y + 1, x + 1), bn(y + 1, x), bn(y, x + 1), bn(y, x)
+            t = [0.0] * 4
+            fo = 0
+            for o in range(18):
+                hv = hist[(y + 1) * nx + (x + 1) + o * nx * ny]
+                hs = [min(hv * nk, 0.2) for nk in (n1, n2, n3, n4)]
+                feats[row, fo] = 0.5 * sum(hs)
+                for i in range(4):
+                    t[i] += hs[i]
+                fo += 1
+            for o in range(9):
+                hv = hist[(y + 1) * nx + (x + 1) + o * nx * ny] + hist[
+                    (y + 1) * nx + (x + 1) + (o + 9) * nx * ny
+                ]
+                hs = [min(hv * nk, 0.2) for nk in (n1, n2, n3, n4)]
+                feats[row, fo] = 0.5 * sum(hs)
+                fo += 1
+            for i in range(4):
+                feats[row, fo] = 0.2357 * t[i]
+                fo += 1
+            feats[row, fo] = 0.0
+    return feats
+
+
+class TestDaisy:
+    def test_matches_naive_transcription(self, rng):
+        img = rng.uniform(size=(40, 40)).astype(np.float32)
+        ext = DaisyExtractor()
+        got = np.asarray(ext(jnp.asarray(img[None])))[0]
+        expected = naive_daisy(img.astype(np.float64), ext)
+        assert got.shape == expected.shape == (4, 200)
+        assert about_eq(got, expected, 1e-3)
+
+    def test_feature_size(self):
+        assert DaisyExtractor().feature_size == 8 * (8 * 3 + 1)
+
+    def test_flat_image_matches_naive(self):
+        # constant image: interior gradients are zero but the zero-padded
+        # 'same' conv creates border energy that normalization amplifies —
+        # the naive transcription must agree exactly (reference behavior)
+        img = np.full((40, 40), 0.7, np.float32)
+        ext = DaisyExtractor()
+        got = np.asarray(ext(jnp.asarray(img[None])))[0]
+        expected = naive_daisy(img.astype(np.float64), ext)
+        assert about_eq(got, expected, 1e-3)
+
+
+class TestHog:
+    def test_matches_naive_transcription(self, rng):
+        img = rng.uniform(0, 255, size=(20, 24, 3)).astype(np.float32)
+        got = np.asarray(HogExtractor(4)(jnp.asarray(img[None] / 255.0)))[0]
+        expected = naive_hog(img.astype(np.float64) / 255.0, 4)
+        assert got.shape == expected.shape
+        assert about_eq(got, expected, 1e-3)
+
+    def test_truncation_feature_zero_and_shapes(self, rng):
+        img = rng.uniform(size=(1, 32, 32, 3)).astype(np.float32)
+        out = np.asarray(HogExtractor(8)(jnp.asarray(img)))
+        nx = ny = 4
+        assert out.shape == (1, (nx - 2) * (ny - 2), 32)
+        assert np.all(out[..., 31] == 0.0)
+
+    def test_too_small_image_gives_empty(self, rng):
+        img = rng.uniform(size=(1, 8, 8, 3)).astype(np.float32)
+        out = np.asarray(HogExtractor(4)(jnp.asarray(img)))
+        assert out.shape == (1, 0, 32)

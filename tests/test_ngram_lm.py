@@ -1,0 +1,85 @@
+"""Indexer + Stupid Backoff tests mirroring the reference suites
+(src/test/scala/nodes/nlp/NGramIndexerSuite.scala,
+src/test/scala/pipelines/nlp/StupidBackoffSuite.scala — including the
+hand-computed backoff scores and the context-colocation invariant)."""
+
+import pytest
+
+from keystone_tpu.ops.ngram_lm import (
+    NaiveBitPackIndexer,
+    NGramIndexerImpl,
+    NGramsCounts,
+    StupidBackoffEstimator,
+    shard_by_initial_bigram,
+)
+from keystone_tpu.ops.nlp import NGramsFeaturizer, Tokenizer
+
+DATA = ["Winter is coming", "Finals are coming", "Summer is coming really soon"]
+
+
+def featurize(orders, mode="default"):
+    toks = Tokenizer()(DATA)
+    grams = NGramsFeaturizer(orders)(toks)
+    return NGramsCounts(mode)(grams)
+
+
+class TestNaiveBitPackIndexer:
+    def test_pack(self):
+        # NGramIndexerSuite "pack()" exact values
+        assert NaiveBitPackIndexer.pack([1]) == 2**40
+        assert NaiveBitPackIndexer.pack([1, 1]) == 2**40 + 2**20 + 2**60
+        assert NaiveBitPackIndexer.pack([1, 1, 1]) == 1 + 2**40 + 2**20 + 2**61
+
+    def test_remove_farthest_word(self):
+        for ix in (NaiveBitPackIndexer, NGramIndexerImpl()):
+            assert ix.remove_farthest_word(ix.pack([1, 2, 3])) == ix.pack([2, 3])
+            assert ix.remove_farthest_word(ix.pack([1, 2])) == ix.pack([2])
+
+    def test_remove_current_word(self):
+        for ix in (NaiveBitPackIndexer, NGramIndexerImpl()):
+            assert ix.remove_current_word(ix.pack([1, 2, 3])) == ix.pack([1, 2])
+            assert ix.remove_current_word(ix.pack([1, 2])) == ix.pack([1])
+
+    def test_unpack_roundtrip(self):
+        packed = NaiveBitPackIndexer.pack([7, 42, 99])
+        assert [NaiveBitPackIndexer.unpack(packed, p) for p in range(3)] == [7, 42, 99]
+        assert NaiveBitPackIndexer.ngram_order(packed) == 3
+
+    def test_rejects_large_word_ids(self):
+        with pytest.raises(ValueError, match="2\\^20"):
+            NaiveBitPackIndexer.pack([1 << 20])
+
+
+class TestStupidBackoff:
+    def _fit(self):
+        ngrams = featurize(range(2, 6), "noAdd")
+        unigrams = {k[0]: v for k, v in featurize([1])}
+        return StupidBackoffEstimator(unigrams).fit(ngrams)
+
+    def test_hand_computed_scores(self):
+        # StupidBackoffSuite "calculates correct scores" (:60-76)
+        lm = self._fit()
+        assert lm.score(("is", "coming")) == 2.0 / 2.0
+        assert lm.score(("is", "coming", "really")) == 1.0 / 2.0
+        assert lm.score(("is", "unseen-coming")) == 0.0
+        assert lm.score(("is-unseen", "coming")) == lm.alpha * 3.0 / lm.num_tokens
+
+    def test_all_scores_in_unit_interval(self):
+        lm = self._fit()
+        scores = lm.scores()
+        assert scores and all(0.0 <= s <= 1.0 for s in scores.values())
+
+    def test_context_colocation_invariant(self):
+        # requireNGramColocation (:27-46): every ngram's backoff context maps
+        # to the same shard under the initial-bigram sharding
+        lm = self._fit()
+        ix = NGramIndexerImpl()
+        num_shards = 4
+        for ngram in lm.ngram_counts:
+            curr = ngram
+            while ix.ngram_order(curr) > 2:
+                ctx = ix.remove_current_word(curr)
+                assert shard_by_initial_bigram(
+                    curr, num_shards
+                ) == shard_by_initial_bigram(ctx, num_shards)
+                curr = ctx
