@@ -43,15 +43,22 @@ def psum_gram(x_block, y_block, axis_name: str = DATA_AXIS):
     return ata, atb
 
 
-def sharded_gram(mesh, x, y):
-    """``(XᵀX, XᵀY)`` for row-sharded ``x``/``y`` via an explicit shard_map."""
+@functools.lru_cache(maxsize=None)
+def _sharded_gram_fn(mesh):
     fn = shard_map(
         functools.partial(psum_gram, axis_name=DATA_AXIS),
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
         out_specs=(P(None, None), P(None, None)),
     )
-    return jax.jit(fn)(x, y)
+    return jax.jit(fn)
+
+
+def sharded_gram(mesh, x, y):
+    """``(XᵀX, XᵀY)`` for row-sharded ``x``/``y`` via an explicit shard_map.
+    Compiled once per (mesh, shape) — the wrapper is cached per mesh so
+    repeated fits hit the jit cache."""
+    return _sharded_gram_fn(mesh)(x, y)
 
 
 def psum_moments(x_block, axis_name: str = DATA_AXIS, nvalid=None):
@@ -80,6 +87,15 @@ def sharded_moments_jit(x):
     return cnt, s, sq
 
 
+@functools.lru_cache(maxsize=None)
+def _all_to_all_fn(mesh, ndim: int, axis_name: str):
+    def body(xs):
+        return jax.lax.all_to_all(xs, axis_name, 0, 0, tiled=True)
+
+    spec = P(DATA_AXIS, *([None] * (ndim - 1)))
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec))
+
+
 def all_to_all_rows(mesh, x, axis_name: str = DATA_AXIS):
     """Reshard rows across the data axis — the partitionBy/shuffle analog.
 
@@ -89,13 +105,7 @@ def all_to_all_rows(mesh, x, axis_name: str = DATA_AXIS):
     round-robin redistribution.  Requires per-shard row count divisible by the
     axis size.
     """
-
-    def body(xs):
-        return jax.lax.all_to_all(xs, axis_name, 0, 0, tiled=True)
-
-    spec = P(DATA_AXIS, *([None] * (x.ndim - 1)))
-    fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
-    return jax.jit(fn)(x)
+    return _all_to_all_fn(mesh, x.ndim, axis_name)(x)
 
 
 def replicate_to(mesh, x):

@@ -14,6 +14,7 @@ import contextlib
 from dataclasses import dataclass
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -98,8 +99,14 @@ def padded_shard_rows(x, mesh: Mesh | None = None):
     d = mesh.shape[DATA_AXIS]
     pad = (-n) % d
     if pad:
-        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
-        x = np.pad(np.asarray(x), widths)
+        # Pad on device — no host round trip for device-resident inputs.
+        x = jnp.concatenate(
+            [
+                jnp.asarray(x),
+                jnp.zeros((pad,) + tuple(x.shape[1:]), jnp.asarray(x).dtype),
+            ],
+            axis=0,
+        )
     return jax.device_put(x, row_sharding(mesh)), n
 
 

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from ..core.pipeline import Identity, LabelEstimator, Transformer
 from ..ops.stats import StandardScaler
 from ..ops.util import VectorSplitter
+from ..parallel.mesh import current_mesh, padded_shard_rows
 from .normal_equations import bcd_least_squares_l2
 
 
@@ -107,10 +108,17 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     mean.
     """
 
-    def __init__(self, block_size: int, num_iter: int = 1, lam: float = 0.0):
+    def __init__(
+        self,
+        block_size: int,
+        num_iter: int = 1,
+        lam: float = 0.0,
+        mesh=None,
+    ):
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam
+        self.mesh = mesh
 
     def fit(
         self,
@@ -121,11 +129,26 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     ) -> BlockLinearMapper:
         """``nvalid``: true global row count when inputs were zero-padded for
         sharding — pad rows are masked back to zero after centering so grams
-        stay exact (see parallel.mesh.padded_shard_rows)."""
+        stay exact (see parallel.mesh.padded_shard_rows).
+
+        With a mesh (explicit or ambient via ``parallel.mesh.use_mesh``) the
+        inputs are row-sharded over the data axis (zero-padding rows to a
+        multiple of the axis size) and the BCD solve runs with (data, model)
+        shardings — the distributed execution of reference
+        BlockLinearMapper.scala:147-204.
+        """
+        mesh = self.mesh if self.mesh is not None else current_mesh()
         if isinstance(features, (list, tuple)):
             blocks = list(features)
         else:
             blocks = VectorSplitter(self.block_size, num_features)(features)
+
+        if mesh is not None:
+            n_true = nvalid if nvalid is not None else labels.shape[0]
+            blocks = [padded_shard_rows(b, mesh)[0] for b in blocks]
+            labels, _ = padded_shard_rows(labels, mesh)
+            if labels.shape[0] != n_true:
+                nvalid = n_true
 
         label_scaler = StandardScaler(normalize_std_dev=False).fit(
             labels, nvalid=nvalid
@@ -143,7 +166,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             b = b * mask
             a_blocks = [a * mask for a in a_blocks]
 
-        models = bcd_least_squares_l2(a_blocks, b, self.lam, self.num_iter)
+        models = bcd_least_squares_l2(
+            a_blocks, b, self.lam, self.num_iter, mesh=mesh
+        )
         return BlockLinearMapper(
             models, self.block_size, label_scaler.mean, feature_scalers
         )

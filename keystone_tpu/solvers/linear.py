@@ -7,6 +7,7 @@ import jax.numpy as jnp
 
 from ..core.pipeline import LabelEstimator, Transformer, node
 from ..ops.stats import StandardScaler, StandardScalerModel
+from ..parallel.mesh import current_mesh, padded_shard_rows
 from .normal_equations import solve_least_squares
 
 
@@ -38,14 +39,26 @@ class LinearMapEstimator(LabelEstimator):
     (reference LinearMapper.scala:63-93): mean-center features and labels
     (mean-only StandardScaler), solve, intercept = label mean."""
 
-    def __init__(self, lam: float | None = None):
+    def __init__(self, lam: float | None = None, mesh=None):
         self.lam = lam
+        self.mesh = mesh
 
     def fit(self, features, labels, nvalid: int | None = None) -> LinearMapper:
         """``nvalid``: true global row count when ``features``/``labels`` were
         zero-padded for sharding (see parallel.mesh.padded_shard_rows) —
         centering turns pad rows into ``-mean``, so they are masked back to
-        zero before the gram."""
+        zero before the gram.
+
+        With a mesh (explicit or ambient), inputs are row-sharded and the
+        normal equations run as a shard_map gram + model-axis-sharded solve.
+        """
+        mesh = self.mesh if self.mesh is not None else current_mesh()
+        if mesh is not None:
+            n_true = nvalid if nvalid is not None else features.shape[0]
+            features, _ = padded_shard_rows(features, mesh)
+            labels, _ = padded_shard_rows(labels, mesh)
+            if features.shape[0] != n_true:
+                nvalid = n_true
         feature_scaler = StandardScaler(normalize_std_dev=False).fit(
             features, nvalid=nvalid
         )
@@ -58,7 +71,7 @@ class LinearMapEstimator(LabelEstimator):
             mask = (jnp.arange(features.shape[0]) < nvalid).astype(a.dtype)[:, None]
             a = a * mask
             b = b * mask
-        x = solve_least_squares(a, b, float(self.lam or 0.0))
+        x = solve_least_squares(a, b, float(self.lam or 0.0), mesh=mesh)
         return LinearMapper(x, label_scaler.mean, feature_scaler)
 
 

@@ -13,10 +13,16 @@ TPU-native re-design:
 * population statistics are plain gemms over the sorted [N, d] block — under
   ``jit`` with row-sharded inputs XLA lowers them to local gram + ICI
   all-reduce (the treeReduce replacement);
-* the per-class solves run inside one jitted ``lax.scan`` over classes — each
-  step dynamic-slices the class's rows (padded to the max class size) out of
-  the sorted array, builds the mixture-weighted normal equations, and does a
-  dense solve; no padded [C, n_max, d] tensor is ever materialized;
+* the per-class solves run as a ``lax.scan`` over *chunks* of classes with a
+  ``vmap`` inside each chunk — ``class_chunk`` classes are gathered, built
+  into mixture-weighted normal equations, and solved concurrently as one
+  batched ``linalg.solve`` (the reference solves all classes concurrently
+  across partitions, :228-263); only a [chunk, n_max, d] slab is ever
+  materialized, never the full [C, n_max, d] tensor;
+* with a mesh, features are row-sharded over the data axis (population
+  grams lower to local gram + ICI all-reduce) and each class chunk is
+  sharded over the model axis — the class-partitioned parallelism of the
+  reference's one-partition-per-class layout;
 * broadcasts/collects disappear (single-controller, arrays stay in HBM).
 
 Semantics (update order, statistics caching across passes, the λ-shifted
@@ -30,16 +36,18 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.pipeline import LabelEstimator
 from ..ops.util import VectorSplitter
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, current_mesh
 from .block import BlockLinearMapper
 
 
-@functools.partial(jax.jit, static_argnames=("n_max",))
+@functools.partial(jax.jit, static_argnames=("n_max", "chunk", "mesh"))
 def _class_solves(
-    xb_pad,  # [N + n_max, d] sorted block features, zero tail
-    res_pad,  # [N + n_max, C] sorted residual, zero tail
+    xb_pad,  # [N + pad, d] sorted block features, zero tail
+    res_pad,  # [N + pad, C] sorted residual, zero tail
     starts,  # [C]
     counts,  # [C]
     pop_cov,  # [d, d]
@@ -51,21 +59,23 @@ def _class_solves(
     lam,
     mixture_weight,
     n_max: int,
+    chunk: int,
+    mesh=None,
 ):
-    """One per-class solve sweep (reference :228-263) via sequential
-    lax.scan — returns ΔW [d, C]."""
+    """Per-class solve sweep (reference :228-263): scan over class chunks,
+    ``chunk`` concurrent batched solves per step — returns ΔW [d, C]."""
     d = xb_pad.shape[1]
     c_total = starts.shape[0]
     w = mixture_weight
     eye = jnp.eye(d, dtype=xb_pad.dtype)
+    row_ids = jnp.arange(n_max)
 
-    def one_class(carry, c):
-        start, cnt = starts[c], counts[c]
+    def one_class(start, cnt, c, xtr_c, jm_c, rm_c, m_c):
         xc = jax.lax.dynamic_slice(xb_pad, (start, 0), (n_max, d))
-        rc = jax.lax.dynamic_slice(res_pad, (start, 0), (n_max, c_total))
-        mask = (jnp.arange(n_max) < cnt).astype(xb_pad.dtype)
+        mask = (row_ids < cnt).astype(xb_pad.dtype)
         xc = xc * mask[:, None]
-        r_c = rc[:, c] * mask  # this class's own residual column (:231)
+        # this class's own residual column (:231)
+        r_c = jax.lax.dynamic_slice(res_pad, (start, c), (n_max, 1))[:, 0] * mask
         n_c = cnt.astype(xb_pad.dtype)
 
         class_mean = jnp.sum(xc, axis=0) / n_c
@@ -79,20 +89,49 @@ def _class_solves(
             + class_cov * w
             + jnp.outer(mean_diff, mean_diff) * ((1.0 - w) * w)
         )
-        mean_mixture_wt = residual_mean[c] * (1.0 - w) + w * (jnp.sum(r_c) / n_c)
-        joint_xtr = (
-            pop_xtr[:, c] * (1.0 - w)
-            + class_xtr * w
-            - joint_means[c] * mean_mixture_wt
-        )
+        mean_mixture_wt = rm_c * (1.0 - w) + w * (jnp.sum(r_c) / n_c)
+        joint_xtr = xtr_c * (1.0 - w) + class_xtr * w - jm_c * mean_mixture_wt
         # λ-shifted solve (reference :259-260)
-        dw = jnp.linalg.solve(
-            joint_xtx + lam * eye, joint_xtr - model_block[:, c] * lam
-        )
-        return carry, dw
+        return jnp.linalg.solve(joint_xtx + lam * eye, joint_xtr - m_c * lam)
 
-    _, dws = jax.lax.scan(one_class, None, jnp.arange(c_total))
-    return dws.T  # [d, C]
+    solve_chunk = jax.vmap(one_class)
+
+    # Pad the class axis to a chunk multiple by repeating class 0 (results
+    # for the repeats are discarded; repeating a real class keeps every
+    # batched solve well-conditioned).
+    n_chunks = -(-c_total // chunk)
+    cls = jnp.arange(c_total)
+    cls_pad = jnp.concatenate(
+        [cls, jnp.zeros(n_chunks * chunk - c_total, cls.dtype)]
+    )
+
+    def chunked(x):
+        return x.reshape((n_chunks, chunk) + x.shape[1:])
+
+    xs = (
+        chunked(starts[cls_pad]),
+        chunked(counts[cls_pad]),
+        chunked(cls_pad),
+        chunked(pop_xtr.T[cls_pad]),
+        chunked(joint_means[cls_pad]),
+        chunked(residual_mean[cls_pad]),
+        chunked(model_block.T[cls_pad]),
+    )
+
+    model_spec = None
+    if mesh is not None and chunk % mesh.shape[MODEL_AXIS] == 0:
+        model_spec = NamedSharding(mesh, P(MODEL_AXIS, None))
+
+    def step(carry, inp):
+        dws = solve_chunk(*inp)  # [chunk, d]
+        if model_spec is not None:
+            # Class-partitioned parallelism: each device in the model axis
+            # owns chunk/model_size of the concurrent class solves.
+            dws = jax.lax.with_sharding_constraint(dws, model_spec)
+        return carry, dws
+
+    _, dws = jax.lax.scan(step, None, xs)  # [n_chunks, chunk, d]
+    return dws.reshape(n_chunks * chunk, d)[:c_total].T  # [d, C]
 
 
 @jax.jit
@@ -118,13 +157,18 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         num_iter: int,
         lam: float,
         mixture_weight: float,
+        class_chunk: int = 16,
+        mesh=None,
     ):
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam
         self.mixture_weight = mixture_weight
+        self.class_chunk = class_chunk
+        self.mesh = mesh
 
     def fit(self, features, labels, num_features: int | None = None) -> BlockLinearMapper:
+        mesh = self.mesh if self.mesh is not None else current_mesh()
         labels_np = np.asarray(labels)
         n, n_classes = labels_np.shape
         class_idx = np.argmax(labels_np, axis=1)
@@ -169,20 +213,39 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         )
 
         models = [jnp.zeros((b.shape[1], n_classes), dtype) for b in blocks]
-        # Keep ONLY the padded copy of each block (zero tail of n_max rows):
-        # the zero tail contributes nothing to gemms/sums, so population
-        # statistics use xb_pad directly with the true count n — no second
-        # full copy of the design matrix stays resident.
+        # Keep ONLY the padded copy of each block (zero tail of >= n_max
+        # rows): the zero tail contributes nothing to gemms/sums, so
+        # population statistics use xb_pad directly with the true count n —
+        # no second full copy of the design matrix stays resident.  With a
+        # mesh the tail additionally rounds the row count up to a data-axis
+        # multiple and the padded blocks are row-sharded: population
+        # gram/XᵀR gemms lower to local gram + ICI all-reduce.
+        pad_total = n_max
+        row_sharding = None
+        if mesh is not None:
+            d_size = mesh.shape[DATA_AXIS]
+            pad_total += (-(n + n_max)) % d_size
+            row_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
         blocks_padded = []
         for b in blocks:
-            blocks_padded.append(
-                jnp.concatenate([b, jnp.zeros((n_max, b.shape[1]), dtype)], axis=0)
+            xb = jnp.concatenate(
+                [b, jnp.zeros((pad_total, b.shape[1]), dtype)], axis=0
             )
+            if row_sharding is not None:
+                xb = jax.device_put(xb, row_sharding)
+            blocks_padded.append(xb)
         del blocks
         onehot_pad = jnp.concatenate(
-            [class_onehot, jnp.zeros((n_classes, n_max), dtype)], axis=1
+            [class_onehot, jnp.zeros((n_classes, pad_total), dtype)], axis=1
         )
-        tail = jnp.zeros((n_max, n_classes), dtype)
+        tail = jnp.zeros((pad_total, n_classes), dtype)
+        chunk = max(1, min(self.class_chunk, n_classes))
+        if mesh is not None:
+            # Round the chunk up to a model-axis multiple so the batched
+            # class solves always shard over the model axis (pad classes in
+            # a partial chunk are repeats of class 0, discarded afterwards).
+            m_size = mesh.shape[MODEL_AXIS]
+            chunk = -(-chunk // m_size) * m_size
         block_stats: list[tuple | None] = [None] * len(blocks_padded)
         lam_arr = jnp.asarray(self.lam, dtype)
         w_arr = jnp.asarray(w, dtype)
@@ -214,6 +277,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     lam_arr,
                     w_arr,
                     n_max,
+                    chunk,
+                    mesh,
                 )
                 models[bi] = models[bi] + dw
                 residual = residual - (xb_pad @ dw)[: residual.shape[0]]
