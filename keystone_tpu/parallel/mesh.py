@@ -99,15 +99,31 @@ def padded_shard_rows(x, mesh: Mesh | None = None):
     d = mesh.shape[DATA_AXIS]
     pad = (-n) % d
     if pad:
-        # Pad on device — no host round trip for device-resident inputs.
-        x = jnp.concatenate(
-            [
-                jnp.asarray(x),
-                jnp.zeros((pad,) + tuple(x.shape[1:]), jnp.asarray(x).dtype),
-            ],
-            axis=0,
-        )
+        if isinstance(x, jax.Array):
+            # Device-resident: pad on device, no host round trip.
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad,) + tuple(x.shape[1:]), x.dtype)], axis=0
+            )
+        else:
+            # Host input: pad on host so the single device_put below
+            # transfers straight into the sharded layout.
+            widths = [(0, pad)] + [(0, 0)] * (np.ndim(x) - 1)
+            x = np.pad(np.asarray(x), widths)
     return jax.device_put(x, row_sharding(mesh)), n
+
+
+def pad_shard_inputs(mesh, nvalid: int | None, *arrays):
+    """Row-shard ``arrays`` over the data axis with shared zero padding.
+
+    Returns ``(list_of_sharded_arrays, nvalid)`` where ``nvalid`` is the true
+    global row count whenever padding was added (callers mask pad rows after
+    centering).  The shared fit preamble of the mesh-aware estimators.
+    """
+    n_true = nvalid if nvalid is not None else arrays[0].shape[0]
+    out = [padded_shard_rows(a, mesh)[0] for a in arrays]
+    if out and out[0].shape[0] != n_true:
+        nvalid = n_true
+    return out, nvalid
 
 
 @dataclass(frozen=True)

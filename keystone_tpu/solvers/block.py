@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from ..core.pipeline import Identity, LabelEstimator, Transformer
 from ..ops.stats import StandardScaler
 from ..ops.util import VectorSplitter
-from ..parallel.mesh import current_mesh, padded_shard_rows
+from ..parallel.mesh import current_mesh, pad_shard_inputs
 from .normal_equations import bcd_least_squares_l2
 
 
@@ -144,11 +144,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             blocks = VectorSplitter(self.block_size, num_features)(features)
 
         if mesh is not None:
-            n_true = nvalid if nvalid is not None else labels.shape[0]
-            blocks = [padded_shard_rows(b, mesh)[0] for b in blocks]
-            labels, _ = padded_shard_rows(labels, mesh)
-            if labels.shape[0] != n_true:
-                nvalid = n_true
+            (*blocks, labels), nvalid = pad_shard_inputs(
+                mesh, nvalid, *blocks, labels
+            )
 
         label_scaler = StandardScaler(normalize_std_dev=False).fit(
             labels, nvalid=nvalid

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 
 from ..core.pipeline import LabelEstimator, Transformer, node
 from ..ops.stats import StandardScaler, StandardScalerModel
-from ..parallel.mesh import current_mesh, padded_shard_rows
+from ..parallel.mesh import current_mesh, pad_shard_inputs
 from .normal_equations import solve_least_squares
 
 
@@ -54,11 +54,9 @@ class LinearMapEstimator(LabelEstimator):
         """
         mesh = self.mesh if self.mesh is not None else current_mesh()
         if mesh is not None:
-            n_true = nvalid if nvalid is not None else features.shape[0]
-            features, _ = padded_shard_rows(features, mesh)
-            labels, _ = padded_shard_rows(labels, mesh)
-            if features.shape[0] != n_true:
-                nvalid = n_true
+            (features, labels), nvalid = pad_shard_inputs(
+                mesh, nvalid, features, labels
+            )
         feature_scaler = StandardScaler(normalize_std_dev=False).fit(
             features, nvalid=nvalid
         )

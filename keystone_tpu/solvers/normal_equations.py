@@ -18,7 +18,7 @@ import jax.scipy.linalg as jsl
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.collectives import sharded_gram
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, padded_shard_rows
 
 
 @jax.jit
@@ -65,14 +65,16 @@ def solve_least_squares(a, b, lam: float = 0.0, mesh=None):
 
     With ``mesh``: grams run as an explicit shard_map (local MXU gram + one
     psum over the data axis — parallel.collectives.sharded_gram) and the
-    triangular solve is model-axis sharded over the class columns.
+    triangular solve is model-axis sharded over the class columns.  Row
+    counts not divisible by the data axis are zero-padded (exact: zero rows
+    contribute nothing to the grams).
     """
     if mesh is None:
         ata, atb = gram(a, b)
         return solve_gram_l2(ata, atb, jnp.asarray(lam, ata.dtype))
-    solve, _, rows = _mesh_solver_fns(mesh)
-    a = jax.device_put(a, rows)
-    b = jax.device_put(b, rows)
+    solve, _, _ = _mesh_solver_fns(mesh)
+    a, _ = padded_shard_rows(a, mesh)
+    b, _ = padded_shard_rows(b, mesh)
     ata, atb = sharded_gram(mesh, a, b)
     return solve(ata, atb, jnp.asarray(lam, ata.dtype))
 
@@ -131,7 +133,9 @@ def bcd_least_squares_l2(
 
     With ``mesh``: block grams run via the explicit shard_map collective and
     every block update is compiled with (data, model) shardings — features
-    row-sharded, model columns sharded over the model axis.
+    row-sharded, model columns sharded over the model axis.  Uneven row
+    counts are zero-padded (exact: zero rows are zero in both the blocks and
+    the labels, so grams and residual updates are unchanged).
     """
     lam = jnp.asarray(lam, labels.dtype)
     nblocks = len(blocks)
@@ -147,9 +151,9 @@ def bcd_least_squares_l2(
         return [solve_least_squares(blocks[0], labels, lam, mesh=mesh)]
 
     if mesh is not None:
-        _, block_update, rows = _mesh_solver_fns(mesh)
-        blocks = [jax.device_put(blk, rows) for blk in blocks]
-        labels = jax.device_put(labels, rows)
+        _, block_update, _ = _mesh_solver_fns(mesh)
+        blocks = [padded_shard_rows(blk, mesh)[0] for blk in blocks]
+        labels, _ = padded_shard_rows(labels, mesh)
         grams = [sharded_gram(mesh, blk, blk[:, :0])[0] for blk in blocks]
     else:
         block_update = _bcd_block_update

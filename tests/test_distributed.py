@@ -88,3 +88,18 @@ def test_bwls_mesh42_matches_local(rng, mesh42):
         np.asarray(local(jnp.asarray(feats))),
         1e-3,
     )
+
+
+def test_graft_dryrun_impl_in_process(devices):
+    """The driver's multi-chip dryrun must drive the real solver path."""
+    import os
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    try:
+        import __graft_entry__ as graft
+
+        graft._dryrun_impl(8)
+    finally:
+        sys.path.remove(repo_root)
