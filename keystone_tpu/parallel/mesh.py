@@ -112,6 +112,29 @@ def padded_shard_rows(x, mesh: Mesh | None = None):
     return jax.device_put(x, row_sharding(mesh)), n
 
 
+def parse_mesh(spec: str | None) -> Mesh | None:
+    """Parse a ``--mesh`` flag: ``"8"`` -> 8-way data mesh, ``"4x2"`` ->
+    (data=4, model=2).  None/empty -> no mesh (single device)."""
+    if not spec:
+        return None
+    parts = spec.lower().split("x")
+    data = int(parts[0])
+    model = int(parts[1]) if len(parts) > 1 else 1
+    return make_mesh(data=data, model=model)
+
+
+def mask_pad_rows(x, nvalid: int | None):
+    """Zero out rows at index >= ``nvalid``.
+
+    Needed after a featurizer that maps zero pad rows to nonzero outputs
+    (e.g. ``cos(0·W + b)`` in CosineRandomFeatures) so downstream moment
+    sums over the padded batch stay exact."""
+    if nvalid is None or x.shape[0] == nvalid:
+        return x
+    mask = (jnp.arange(x.shape[0]) < nvalid).astype(x.dtype)
+    return x * mask.reshape((-1,) + (1,) * (x.ndim - 1))
+
+
 def pad_shard_inputs(mesh, nvalid: int | None, *arrays):
     """Row-shard ``arrays`` over the data axis with shared zero padding.
 

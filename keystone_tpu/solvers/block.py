@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from ..core.pipeline import Identity, LabelEstimator, Transformer
 from ..ops.stats import StandardScaler
 from ..ops.util import VectorSplitter
-from ..parallel.mesh import current_mesh, pad_shard_inputs
+from ..parallel.mesh import current_mesh, mask_pad_rows, pad_shard_inputs
 from .normal_equations import bcd_least_squares_l2
 
 
@@ -159,10 +159,8 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         ]
         a_blocks = [scaler(blk) for scaler, blk in zip(feature_scalers, blocks)]
 
-        if nvalid is not None and nvalid < labels.shape[0]:
-            mask = (jnp.arange(labels.shape[0]) < nvalid).astype(b.dtype)[:, None]
-            b = b * mask
-            a_blocks = [a * mask for a in a_blocks]
+        b = mask_pad_rows(b, nvalid)
+        a_blocks = [mask_pad_rows(a, nvalid) for a in a_blocks]
 
         models = bcd_least_squares_l2(
             a_blocks, b, self.lam, self.num_iter, mesh=mesh

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 
 from ..core.pipeline import LabelEstimator, Transformer, node
 from ..ops.stats import StandardScaler, StandardScalerModel
-from ..parallel.mesh import current_mesh, pad_shard_inputs
+from ..parallel.mesh import current_mesh, mask_pad_rows, pad_shard_inputs
 from .normal_equations import solve_least_squares
 
 
@@ -63,12 +63,8 @@ class LinearMapEstimator(LabelEstimator):
         label_scaler = StandardScaler(normalize_std_dev=False).fit(
             labels, nvalid=nvalid
         )
-        a = feature_scaler(features)
-        b = label_scaler(labels)
-        if nvalid is not None and nvalid < features.shape[0]:
-            mask = (jnp.arange(features.shape[0]) < nvalid).astype(a.dtype)[:, None]
-            a = a * mask
-            b = b * mask
+        a = mask_pad_rows(feature_scaler(features), nvalid)
+        b = mask_pad_rows(label_scaler(labels), nvalid)
         x = solve_least_squares(a, b, float(self.lam or 0.0), mesh=mesh)
         return LinearMapper(x, label_scaler.mean, feature_scaler)
 

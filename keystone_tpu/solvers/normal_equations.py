@@ -60,6 +60,15 @@ def _mesh_solver_fns(mesh):
     return solve, block_update_jit, rows
 
 
+def _pad_cols(x, mult: int):
+    """Zero-pad trailing columns to a multiple of ``mult`` (exact for the
+    solvers: zero label columns produce zero weight columns)."""
+    pad = (-x.shape[1]) % mult
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad)))
+    return x, pad
+
+
 def solve_least_squares(a, b, lam: float = 0.0, mesh=None):
     """One-shot (regularized) least squares ``min ‖AX - B‖² + λ‖X‖²``.
 
@@ -75,8 +84,10 @@ def solve_least_squares(a, b, lam: float = 0.0, mesh=None):
     solve, _, _ = _mesh_solver_fns(mesh)
     a, _ = padded_shard_rows(a, mesh)
     b, _ = padded_shard_rows(b, mesh)
+    b, col_pad = _pad_cols(b, mesh.shape[MODEL_AXIS])
     ata, atb = sharded_gram(mesh, a, b)
-    return solve(ata, atb, jnp.asarray(lam, ata.dtype))
+    x = solve(ata, atb, jnp.asarray(lam, ata.dtype))
+    return x[:, : x.shape[1] - col_pad] if col_pad else x
 
 
 class NormalEquations:
@@ -139,6 +150,26 @@ def bcd_least_squares_l2(
     """
     lam = jnp.asarray(lam, labels.dtype)
     nblocks = len(blocks)
+
+    if nblocks == 1 and models_init is None:
+        # Degenerate case = plain normal equations; skip the residual machinery.
+        return [solve_least_squares(blocks[0], labels, lam, mesh=mesh)]
+
+    col_pad = 0
+    if mesh is not None:
+        _, block_update, _ = _mesh_solver_fns(mesh)
+        blocks = [padded_shard_rows(blk, mesh)[0] for blk in blocks]
+        labels, _ = padded_shard_rows(labels, mesh)
+        # Class columns shard over the model axis; pad to a multiple (zero
+        # label columns stay zero through every BCD update).
+        labels, col_pad = _pad_cols(labels, mesh.shape[MODEL_AXIS])
+        if models_init is not None and col_pad:
+            models_init = [_pad_cols(m, mesh.shape[MODEL_AXIS])[0] for m in models_init]
+        grams = [sharded_gram(mesh, blk, blk[:, :0])[0] for blk in blocks]
+    else:
+        block_update = _bcd_block_update
+        grams = [gram(blk, labels[:, :0])[0] for blk in blocks]
+
     if models_init is None:
         models = [
             jnp.zeros((blk.shape[1], labels.shape[1]), labels.dtype) for blk in blocks
@@ -146,23 +177,12 @@ def bcd_least_squares_l2(
     else:
         models = list(models_init)
 
-    if nblocks == 1 and models_init is None:
-        # Degenerate case = plain normal equations; skip the residual machinery.
-        return [solve_least_squares(blocks[0], labels, lam, mesh=mesh)]
-
-    if mesh is not None:
-        _, block_update, _ = _mesh_solver_fns(mesh)
-        blocks = [padded_shard_rows(blk, mesh)[0] for blk in blocks]
-        labels, _ = padded_shard_rows(labels, mesh)
-        grams = [sharded_gram(mesh, blk, blk[:, :0])[0] for blk in blocks]
-    else:
-        block_update = _bcd_block_update
-        grams = [gram(blk, labels[:, :0])[0] for blk in blocks]
-
     residual = _bcd_residual_init(tuple(blocks), tuple(models), labels)
     for _ in range(num_iter):
         for i in range(nblocks):
             models[i], residual = block_update(
                 blocks[i], grams[i], models[i], residual, lam
             )
+    if col_pad:
+        models = [m[:, : m.shape[1] - col_pad] for m in models]
     return models

@@ -23,6 +23,7 @@ from ..evaluation.multiclass import MulticlassClassifierEvaluator
 from ..loaders.timit import TIMIT_DIMENSION, TIMIT_NUM_CLASSES, TimitFeaturesData, timit_features_loader
 from ..ops.stats import CosineRandomFeatures, StandardScaler
 from ..ops.util import ClassLabelIndicatorsFromIntLabels, MaxClassifier
+from ..parallel.mesh import mask_pad_rows, padded_shard_rows, parse_mesh
 from ..solvers.block import BlockLeastSquaresEstimator
 
 
@@ -49,8 +50,13 @@ class _Log(Logging):
     pass
 
 
-def build_batch_featurizers(conf: TimitConfig, train_data) -> list:
-    """numCosines [CosineRandomFeatures -> StandardScaler] chains (:65-84)."""
+def build_batch_featurizers(conf: TimitConfig, train_data, nvalid=None) -> list:
+    """numCosines [CosineRandomFeatures -> StandardScaler] chains (:65-84).
+
+    ``nvalid``: true row count when ``train_data`` carries zero pad rows —
+    cos maps zero rows to nonzero ``cos(b)``, so pad rows are masked back to
+    zero before the scaler's moment sums.
+    """
     key = jax.random.PRNGKey(conf.seed)
     featurizers = []
     for _ in range(conf.num_cosines):
@@ -62,33 +68,45 @@ def build_batch_featurizers(conf: TimitConfig, train_data) -> list:
             sub,
             w_dist=conf.rf_type,
         )
-        scaler = StandardScaler().fit(rf(train_data))
+        feats = mask_pad_rows(rf(train_data), nvalid)
+        scaler = StandardScaler().fit(feats, nvalid=nvalid)
         featurizers.append(rf.then(scaler))
     return featurizers
 
 
-def run(conf: TimitConfig, data: TimitFeaturesData) -> dict:
+def run(conf: TimitConfig, data: TimitFeaturesData, mesh=None) -> dict:
+    """With ``mesh``, features are row-sharded over the data axis and the
+    multi-epoch BCD solver runs distributed — the reference runs this over
+    partitioned RDDs end to end (TimitPipeline.scala:58-113)."""
     configure_logging()
     log = _Log()
     t0 = time.perf_counter()
 
-    train_data = jnp.asarray(data.train.data)
-    batch_featurizer = build_batch_featurizers(conf, train_data)
-    training_batches = [f(train_data) for f in batch_featurizer]
+    n_test = len(data.test.labels)
+    if mesh is not None:
+        train_data, nvalid = padded_shard_rows(data.train.data, mesh)
+        test_data, _ = padded_shard_rows(data.test.data, mesh)
+    else:
+        train_data, nvalid = jnp.asarray(data.train.data), None
+        test_data = jnp.asarray(data.test.data)
+
+    batch_featurizer = build_batch_featurizers(conf, train_data, nvalid)
+    training_batches = [
+        mask_pad_rows(f(train_data), nvalid) for f in batch_featurizer
+    ]
 
     labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(data.train.labels)
 
-    test_data = jnp.asarray(data.test.data)
     test_batches = [f(test_data) for f in batch_featurizer]
 
     model = BlockLeastSquaresEstimator(
-        conf.num_cosine_features, conf.num_epochs, conf.lam
-    ).fit(training_batches, labels)
+        conf.num_cosine_features, conf.num_epochs, conf.lam, mesh=mesh
+    ).fit(training_batches, labels, nvalid=nvalid)
 
     results: dict = {}
 
     def evaluator(pred):
-        predicted = MaxClassifier()(pred)
+        predicted = MaxClassifier()(pred[:n_test])
         ev = MulticlassClassifierEvaluator(
             predicted, data.test.labels, conf.num_classes
         )
@@ -111,6 +129,11 @@ def main(argv=None):
     p.add_argument("--gamma", type=float, default=0.05555)
     p.add_argument("--lambda", dest="lam", type=float, default=0.0)
     p.add_argument("--rfType", choices=["gaussian", "cauchy"], default="gaussian")
+    p.add_argument(
+        "--mesh",
+        default=None,
+        help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
+    )
     a = p.parse_args(argv)
     conf = TimitConfig(
         train_data_location=a.trainDataLocation,
@@ -129,7 +152,7 @@ def main(argv=None):
         conf.test_data_location,
         conf.test_labels_location,
     )
-    return run(conf, data)
+    return run(conf, data, mesh=parse_mesh(a.mesh))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,83 @@
+"""Mesh-enabled workload tests: sharded run == single-device run.
+
+The reference runs every pipeline over partitioned RDDs (e.g.
+RandomPatchCifar.scala:20-85); here each workload's ``run(..., mesh=...)``
+must reproduce the single-device result on the virtual 8-device platform.
+"""
+
+import numpy as np
+
+from keystone_tpu.loaders.cifar import cifar_loader
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.loaders.timit import timit_features_loader
+from keystone_tpu.workloads.cifar_random_patch import RandomCifarConfig
+from keystone_tpu.workloads.cifar_random_patch import run as cifar_run
+from keystone_tpu.workloads.mnist_random_fft import MnistRandomFFTConfig
+from keystone_tpu.workloads.mnist_random_fft import run as mnist_run
+from keystone_tpu.workloads.timit import TimitConfig
+from keystone_tpu.workloads.timit import run as timit_run
+
+from test_cifar_pipeline import write_synthetic_cifar
+from test_timit import write_split
+
+
+def _mnist_data(rng, n, d=64, k=5, centers=None):
+    if centers is None:
+        centers = rng.normal(size=(k, d))
+    labels = rng.integers(0, k, n)
+    data = (centers[labels] + 0.3 * rng.normal(size=(n, d))).astype(np.float32)
+    return LabeledData(data=data, labels=labels.astype(np.int32)), centers
+
+
+def test_mnist_random_fft_mesh_matches_local(rng, mesh42):
+    train, centers = _mnist_data(rng, 203)  # deliberately not divisible by 4
+    test, _ = _mnist_data(rng, 101, centers=centers)
+    conf = MnistRandomFFTConfig(
+        num_ffts=2, block_size=512, lam=1e-2, mnist_image_size=64, num_classes=5
+    )
+    local = mnist_run(conf, train, test)
+    sharded = mnist_run(conf, train, test, mesh=mesh42)
+    assert abs(sharded["train_error"] - local["train_error"]) < 1e-6
+    assert abs(sharded["test_error"] - local["test_error"]) < 1e-6
+
+
+def test_timit_mesh_matches_local(rng, mesh8, tmp_path):
+    d, k = 24, 6
+    centers = rng.normal(scale=2.0, size=(k, d))
+    tdp, tlp, _ = write_split(tmp_path, "train", 205, rng, centers)
+    sdp, slp, _ = write_split(tmp_path, "test", 101, rng, centers)
+    data = timit_features_loader(tdp, tlp, sdp, slp)
+    conf = TimitConfig(
+        num_cosines=2,
+        num_cosine_features=128,
+        num_epochs=2,
+        gamma=0.2,
+        lam=1e-3,
+        num_classes=k,
+        dimension=d,
+    )
+    local = timit_run(conf, data)
+    sharded = timit_run(conf, data, mesh=mesh8)
+    assert abs(sharded["test_error"] - local["test_error"]) < 1.1
+
+
+def test_cifar_random_patch_mesh_matches_local(rng, mesh8, tmp_path):
+    train_path = str(tmp_path / "train.bin")
+    test_path = str(tmp_path / "test.bin")
+    palette = rng.uniform(40, 215, (4, 3))
+    write_synthetic_cifar(train_path, 201, rng, base=palette)
+    write_synthetic_cifar(test_path, 99, rng, base=palette)
+    conf = RandomCifarConfig(
+        num_filters=12,
+        patch_size=6,
+        patch_steps=2,
+        lam=10.0,
+        whitener_size=1500,
+        featurize_chunk=64,
+        num_classes=4,
+    )
+    train, test = cifar_loader(train_path), cifar_loader(test_path)
+    local = cifar_run(conf, train, test)
+    sharded = cifar_run(conf, train, test, mesh=mesh8)
+    assert abs(sharded["train_error"] - local["train_error"]) < 1.1
+    assert abs(sharded["test_error"] - local["test_error"]) < 1.1
