@@ -39,9 +39,11 @@ class HogExtractor(Transformer):
     def __call__(self, batch):
         n, h, w, c = batch.shape
         bs = self.bin_size
-        # reference x = column axis (xDim), y = row axis
-        nx = int(round(w / bs))
-        ny = int(round(h / bs))
+        # reference x = column axis (xDim), y = row axis; the reference's
+        # math.round rounds half AWAY from zero (Python's round() would
+        # banker-round 0.5 down and change the cell grid).
+        nx = int(np.floor(w / bs + 0.5))
+        ny = int(np.floor(h / bs + 0.5))
         vis_x = nx * bs
         vis_y = ny * bs
 

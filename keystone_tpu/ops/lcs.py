@@ -76,7 +76,16 @@ class LCSExtractor(Transformer):
     def _neighborhood(self) -> np.ndarray:
         s = self.sub_patch_size
         # reference :66-71: -2s + s/2 - 1  to  s + s/2 - 1  by s
-        return np.arange(-2 * s + s // 2 - 1, s + s // 2 - 1 + 1, s)
+        nbr = np.arange(-2 * s + s // 2 - 1, s + s // 2 - 1 + 1, s)
+        # JAX would silently wrap negative sample coordinates to the far
+        # edge (the Scala reference throws); fail loudly instead.
+        if self.stride_start + nbr.min() < 0:
+            raise ValueError(
+                f"stride_start={self.stride_start} too small for "
+                f"sub_patch_size={s}: sample offset {nbr.min()} would index "
+                "before the image edge"
+            )
+        return nbr
 
     def num_keypoints(self, h: int, w: int) -> int:
         return len(self._keypoints(w)) * len(self._keypoints(h))

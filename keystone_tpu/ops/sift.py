@@ -116,6 +116,14 @@ def _scale_geometry(h: int, w: int, step: int, bin_size: int, num_scales: int, s
     """Frame-origin grids per reference VLFeat.cxx:93-95 and vl_dsift bounds:
     origins from ``off`` while origin + 3b <= dim-1."""
     off = (1 + 2 * num_scales) - 3 * scale
+    if off < 0:
+        # vl_dsift never starts before the frame; a negative origin would
+        # silently wrap under JAX indexing — fail loudly for scale counts
+        # outside the reference envelope (VLFeat.cxx:93-95).
+        raise ValueError(
+            f"scale={scale} with num_scales={num_scales} yields negative "
+            f"grid origin {off}; use scales <= {(1 + 2 * num_scales) // 3}"
+        )
     span = NUM_BIN_XY - 1  # bin centers at origin + {0,1,2,3}*b
     xs = np.arange(off, w - 1 - span * bin_size + 1, step)
     ys = np.arange(off, h - 1 - span * bin_size + 1, step)

@@ -37,16 +37,27 @@ class NaiveBayesModel(Transformer):
             return self._apply_csr(batch)
         return batch @ self.theta.T + self.pi
 
+    # Chunk the nnz axis so the [chunk, C] gather intermediate stays bounded
+    # even for corpora whose nnz dwarfs the dense input.
+    NNZ_CHUNK = 1 << 22
+
     def _apply_csr(self, csr: CSRFeatures):
         # gather theta columns at the nonzeros, scale, segment-sum by row
         n = len(csr)
+        # int64 on host: nnz can exceed int32 for large corpora
         row_ids = np.repeat(
-            np.arange(n, dtype=np.int32), np.diff(csr.indptr).astype(np.int64)
+            np.arange(n, dtype=np.int64), np.diff(csr.indptr).astype(np.int64)
         )
-        cols = jnp.asarray(csr.indices)
-        vals = jnp.asarray(csr.values)
-        contrib = self.theta.T[cols] * vals[:, None]  # [nnz, C]
-        scores = jax.ops.segment_sum(contrib, jnp.asarray(row_ids), num_segments=n)
+        nnz = row_ids.shape[0]
+        scores = jnp.zeros((n, self.theta.shape[0]), self.theta.dtype)
+        for lo in range(0, max(nnz, 1), self.NNZ_CHUNK):
+            hi = min(lo + self.NNZ_CHUNK, nnz)
+            cols = jnp.asarray(csr.indices[lo:hi])
+            vals = jnp.asarray(csr.values[lo:hi])
+            contrib = self.theta.T[cols] * vals[:, None]  # [chunk, C]
+            scores = scores + jax.ops.segment_sum(
+                contrib, jnp.asarray(row_ids[lo:hi]), num_segments=n
+            )
         return scores + self.pi
 
 

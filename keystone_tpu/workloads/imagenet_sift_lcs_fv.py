@@ -37,6 +37,9 @@ from .fv_common import (
     scatter_features,
 )
 
+# Hard cap on the GMM EM training set (reference ImageNetSiftLcsFV.scala:85-86).
+GMM_FIT_CAP = 1_000_000
+
 
 @dataclass
 class ImageNetSiftLcsFVConfig:
@@ -97,6 +100,11 @@ def _fit_branch(conf: ImageNetSiftLcsFVConfig, desc_buckets: dict, pca_file, gmm
         gmm = GaussianMixtureModel.load(mean_f, var_f, wts_f)
     else:
         gmm_samples = sample_columns(pca_desc, conf.num_gmm_samples, seed + 1)
+        # The reference caps the EM training set at 1e6 samples regardless of
+        # numGmmSamples (shuffleArray(...).take(1e6),
+        # ImageNetSiftLcsFV.scala:85-86) — match it to bound EM compute/HBM.
+        if gmm_samples.shape[1] > GMM_FIT_CAP:
+            gmm_samples = gmm_samples[:, :GMM_FIT_CAP]
         gmm = GaussianMixtureModelEstimator(conf.vocab_size).fit(gmm_samples.T)
 
     return batch_pca, fisher_feature_pipeline(gmm), pca_desc

@@ -1,0 +1,112 @@
+"""StupidBackoffPipeline — n-gram language model training
+(reference src/main/scala/pipelines/nlp/StupidBackoffPipeline.scala:9-59).
+
+Flow: text lines -> Tokenizer -> WordFrequencyEncoder fit + encode ->
+NGramsFeaturizer(2..n) -> NGramsCounts(noAdd) -> StupidBackoffEstimator ->
+scores.  Prints corpus statistics and the first 100 trained scores exactly
+as the reference (:45-53).
+
+``--numParts`` keeps flag parity with the reference, where it controls the
+InitialBigramPartitioner shuffle (StupidBackoff.scala:25-58); here scoring
+is host-local, so the flag drives the same sharding function
+(``shard_by_initial_bigram``) to report the shard layout a multi-host run
+would use — and to assert the co-location invariant (every ngram on the
+same shard as its scoring context).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from collections import Counter
+from dataclasses import dataclass
+
+from ..core.logging import Logging, configure_logging
+from ..ops.ngram_lm import (
+    NGramIndexerImpl,
+    NGramsCounts,
+    StupidBackoffEstimator,
+    shard_by_initial_bigram,
+)
+from ..ops.nlp import NGramsFeaturizer, Tokenizer, fit_word_frequency_encoder
+
+
+@dataclass
+class StupidBackoffConfig:
+    """Flag-parity with the reference scopt config (:13-21)."""
+
+    train_data: str = ""
+    num_parts: int = 16
+    n: int = 3
+
+
+class _Log(Logging):
+    pass
+
+
+def run(conf: StupidBackoffConfig, lines: list) -> dict:
+    configure_logging()
+    log = _Log()
+    t0 = time.perf_counter()
+
+    text = Tokenizer()(lines)
+
+    # Vocab generation step (:33-35)
+    frequency_encode = fit_word_frequency_encoder(text)
+    unigram_counts = frequency_encode.unigram_counts
+
+    # NGram (n >= 2) generation step (:37-42)
+    encoded = frequency_encode(text)
+    ngrams = NGramsFeaturizer(range(2, conf.n + 1))(encoded)
+    ngram_counts = NGramsCounts("noAdd")(ngrams)
+
+    # Stupid backoff scoring step (:44-46)
+    language_model = StupidBackoffEstimator(unigram_counts).fit(ngram_counts)
+    scores = language_model.scores()
+
+    # Shard layout a multi-host run would use (InitialBigramPartitioner):
+    # every ngram must land with its scoring context (same first two words).
+    indexer = NGramIndexerImpl()
+    shard_sizes = Counter()
+    for ngram in language_model.ngram_counts:
+        shard = shard_by_initial_bigram(ngram, conf.num_parts, indexer)
+        shard_sizes[shard] += 1
+        if indexer.ngram_order(ngram) > 2:
+            context = indexer.remove_current_word(ngram)
+            assert (
+                shard_by_initial_bigram(context, conf.num_parts, indexer) == shard
+            ), f"ngram {ngram} not co-located with context {context}"
+
+    results = {
+        "num_tokens": language_model.num_tokens,
+        "vocab_size": len(unigram_counts),
+        "num_ngrams": len(scores),
+        "shard_sizes": dict(shard_sizes),
+        "seconds": time.perf_counter() - t0,
+    }
+    log.log_info(
+        "number of tokens: %s\nsize of vocabulary: %s\nnumber of ngrams: %s",
+        results["num_tokens"],
+        results["vocab_size"],
+        results["num_ngrams"],
+    )
+    log.log_info("trained scores of 100 ngrams in the corpus:")
+    for ngram, score in list(scores.items())[:100]:
+        log.log_info("%s -> %.6f", ngram, score)
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("StupidBackoffPipeline")
+    p.add_argument("--trainData", required=True)
+    p.add_argument("--numParts", type=int, default=16)
+    p.add_argument("--n", type=int, default=3)
+    a = p.parse_args(argv)
+    conf = StupidBackoffConfig(train_data=a.trainData, num_parts=a.numParts, n=a.n)
+    with open(conf.train_data, encoding="utf-8") as fh:
+        lines = [ln.rstrip("\n") for ln in fh if ln.strip()]
+    return run(conf, lines)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""CoreNLP-equivalent featurizer tests, mirroring the reference suite
+(src/test/scala/nodes/nlp/CoreNLPFeatureExtractorSuite.scala)."""
+
+from keystone_tpu.ops.corenlp import CoreNLPFeatureExtractor, lemmatize
+
+
+class TestLemmatization:
+    def test_reference_cases(self):
+        """Reference 'lemmatization' test (:9-27)."""
+        tokens = set(
+            CoreNLPFeatureExtractor(range(1, 4)).apply_item(
+                "jumping snakes lakes oceans hunted"
+            )
+        )
+        for lemma in ("jump", "snake", "lake", "ocean", "hunt"):
+            assert lemma in tokens, tokens
+        for raw in ("jumping", "snakes", "lakes", "oceans", "hunted"):
+            assert raw not in tokens
+
+    def test_rules(self):
+        assert lemmatize("making") == "make"
+        assert lemmatize("hopped") == "hop"
+        assert lemmatize("cities") == "city"
+        assert lemmatize("churches") == "church"
+        assert lemmatize("ran") == "run"
+        assert lemmatize("mice") == "mouse"
+        assert lemmatize("ring") == "ring"  # not an inflection
+        assert lemmatize("glasses") == "glass"
+
+
+class TestEntityExtraction:
+    def test_reference_cases(self):
+        """Reference 'entity extraction' test (:29-42)."""
+        tokens = set(
+            CoreNLPFeatureExtractor(range(1, 4)).apply_item(
+                "John likes cake and he lives in Florida"
+            )
+        )
+        assert "PERSON" in tokens
+        assert "LOCATION" in tokens
+        assert "John" not in tokens and "john" not in tokens
+        assert "Florida" not in tokens and "florida" not in tokens
+
+    def test_org_and_number(self):
+        tokens = set(
+            CoreNLPFeatureExtractor([1]).apply_item(
+                "Acme Corp hired 300 people from Google"
+            )
+        )
+        assert "ORGANIZATION" in tokens
+        assert "NUMBER" in tokens
+
+
+class TestNGrams:
+    def test_reference_cases(self):
+        """Reference '1-2-3-grams' test (:44-66)."""
+        tokens = set(CoreNLPFeatureExtractor(range(1, 4)).apply_item("a b c d"))
+        for t in ("a", "b", "c", "d", "a b", "b c", "c d", "a b c", "b c d"):
+            assert t in tokens
+
+    def test_sentence_boundaries(self):
+        """N-grams never cross sentence boundaries (reference :27-33 maps
+        per sentence)."""
+        tokens = set(
+            CoreNLPFeatureExtractor([2]).apply_item("a b. c d")
+        )
+        assert "a b" in tokens and "c d" in tokens
+        assert "b c" not in tokens
+
+    def test_batch_form(self):
+        out = CoreNLPFeatureExtractor([1])(["a b", "c"])
+        assert out == [["a", "b"], ["c"]]

@@ -1,0 +1,83 @@
+"""E2E tests for LinearPixels, RandomCifar, StupidBackoffPipeline
+(reference LinearPixels.scala:14-55, RandomCifar.scala:17-70,
+StupidBackoffPipeline.scala:9-59)."""
+
+import numpy as np
+
+from keystone_tpu.loaders.cifar import cifar_loader
+from keystone_tpu.workloads.linear_pixels import LinearPixelsConfig
+from keystone_tpu.workloads.linear_pixels import run as lp_run
+from keystone_tpu.workloads.random_cifar import RandomCifarWorkloadConfig
+from keystone_tpu.workloads.random_cifar import run as rc_run
+from keystone_tpu.workloads.stupid_backoff import StupidBackoffConfig
+from keystone_tpu.workloads.stupid_backoff import run as sb_run
+
+from test_cifar_pipeline import write_synthetic_cifar
+
+
+def _cifar_pair(tmp_path, rng, n_train=200, n_test=80, palette=None):
+    train_path = str(tmp_path / "train.bin")
+    test_path = str(tmp_path / "test.bin")
+    if palette is None:
+        palette = rng.uniform(40, 215, (4, 3))
+    write_synthetic_cifar(train_path, n_train, rng, base=palette)
+    write_synthetic_cifar(test_path, n_test, rng, base=palette)
+    return cifar_loader(train_path), cifar_loader(test_path)
+
+
+# LinearPixels featurizes to GRAYSCALE pixels: the class palette must stay
+# separable after NTSC luminance collapse.
+_LUMA_PALETTE = np.array(
+    [[40.0, 40.0, 40.0], [100.0, 100.0, 100.0], [160.0, 160.0, 160.0], [220.0, 220.0, 220.0]]
+)
+
+
+def test_linear_pixels_learns_color_classes(tmp_path, rng):
+    # n > d=1024: unregularized OLS needs an overdetermined system (the
+    # reference runs this on 50k-row CIFAR).
+    train, test = _cifar_pair(
+        tmp_path, rng, n_train=1600, n_test=200, palette=_LUMA_PALETTE
+    )
+    conf = LinearPixelsConfig(num_classes=4)
+    results = lp_run(conf, train, test)
+    # Luminance-separable blobs: well above 25% chance.
+    assert results["train_accuracy"] > 0.5, results
+    assert results["test_accuracy"] > 0.5, results
+
+
+def test_linear_pixels_mesh_matches_local(tmp_path, rng, mesh8):
+    train, test = _cifar_pair(
+        tmp_path, rng, n_train=1601, n_test=101, palette=_LUMA_PALETTE
+    )
+    conf = LinearPixelsConfig(num_classes=4)
+    local = lp_run(conf, train, test)
+    sharded = lp_run(conf, train, test, mesh=mesh8)
+    assert abs(sharded["test_accuracy"] - local["test_accuracy"]) < 0.03
+
+
+def test_random_cifar_learns_synthetic_classes(tmp_path, rng):
+    train, test = _cifar_pair(tmp_path, rng, n_train=300, n_test=100)
+    conf = RandomCifarWorkloadConfig(
+        num_filters=16, lam=10.0, num_classes=4, featurize_chunk=64
+    )
+    results = rc_run(conf, train, test)
+    assert results["test_error"] < 25.0, results
+
+
+def test_stupid_backoff_pipeline(rng):
+    corpus = [
+        "the cat sat on the mat",
+        "the cat ate the fish",
+        "a dog sat on the mat",
+        "the dog and the cat",
+    ] * 3
+    conf = StupidBackoffConfig(num_parts=4, n=3)
+    results = sb_run(conf, corpus)
+    assert results["num_tokens"] == sum(len(l.split()) for l in corpus)
+    assert results["vocab_size"] == len(
+        {w for l in corpus for w in l.split()}
+    )
+    assert results["num_ngrams"] > 0
+    # every counted ngram scored within [0, 1] (asserted inside scores());
+    # the shard layout must cover <= num_parts shards
+    assert set(results["shard_sizes"]) <= set(range(conf.num_parts))
