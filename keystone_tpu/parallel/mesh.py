@@ -118,6 +118,15 @@ def parse_mesh(spec: str | None) -> Mesh | None:
     if not spec:
         return None
     parts = spec.lower().split("x")
+    if (
+        len(parts) > 2
+        or not all(p.strip().isdigit() for p in parts)
+        or any(int(p) == 0 for p in parts)
+    ):
+        raise ValueError(
+            f"bad --mesh spec {spec!r}: expected 'DATA' or 'DATAxMODEL' "
+            "with positive sizes (e.g. '8' or '4x2')"
+        )
     data = int(parts[0])
     model = int(parts[1]) if len(parts) > 1 else 1
     return make_mesh(data=data, model=model)

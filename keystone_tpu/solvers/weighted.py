@@ -43,6 +43,11 @@ from ..ops.util import VectorSplitter
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, current_mesh
 from .block import BlockLinearMapper
 
+# Per-row byte budget for the column-chunked device gather in the class
+# shuffle: each chunk transiently materializes [p_tot, chunk_bytes] un-sharded
+# per device (e.g. 2 KB/row x 1.25M rows = 2.5 GB slab at ImageNet scale).
+_GATHER_COL_CHUNK = 2048
+
 
 @functools.partial(jax.jit, static_argnames=("n_max", "chunk", "mesh"))
 def _class_solves(
@@ -134,12 +139,24 @@ def _class_solves(
     return dws.reshape(n_chunks * chunk, d)[:c_total].T  # [d, C]
 
 
-@jax.jit
-def _residual_class_means(res, class_onehot, counts):
+@functools.partial(jax.jit, static_argnames=("num_classes",))
+def _class_sums(x_pad, seg_ids, num_classes: int):
+    """Per-class row sums of a (sorted, padded) block via segment sum.
+
+    ``seg_ids`` maps each row to its class, with pad rows mapped to segment
+    ``num_classes`` which is dropped — a segment sum replaces round 2's
+    [C, N] one-hot matmul (O(N) index memory instead of O(N·C))."""
+    sums = jax.ops.segment_sum(
+        x_pad, seg_ids, num_segments=num_classes + 1, indices_are_sorted=True
+    )
+    return sums[:num_classes]
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes",))
+def _residual_class_means(res_pad, seg_ids, counts, num_classes: int):
     """Per-class column means of the residual, averaged over classes with
     equal class weight (reference :165-167, :283-287)."""
-    sums = class_onehot @ res  # [C, C]
-    means = sums / counts[:, None]
+    means = _class_sums(res_pad, seg_ids, num_classes) / counts[:, None]
     return jnp.mean(means, axis=0)
 
 
@@ -167,78 +184,141 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         self.class_chunk = class_chunk
         self.mesh = mesh
 
-    def fit(self, features, labels, num_features: int | None = None) -> BlockLinearMapper:
+    def fit(
+        self,
+        features,
+        labels,
+        num_features: int | None = None,
+        nvalid: int | None = None,
+    ) -> BlockLinearMapper:
+        """``features``/``labels`` may be host arrays OR device-resident
+        (row-sharded) ``jax.Array``s — the full design matrix is never
+        materialized on host.  ``nvalid``: true global row count when the
+        inputs carry zero pad rows from ``padded_shard_rows``; pad rows are
+        excluded from the class grouping."""
         mesh = self.mesh if self.mesh is not None else current_mesh()
-        labels_np = np.asarray(labels)
-        n, n_classes = labels_np.shape
-        class_idx = np.argmax(labels_np, axis=1)
+        n = nvalid if nvalid is not None else np.shape(labels)[0]
+        n_classes = np.shape(labels)[1]
+        # Class of each valid row: device argmax for device labels, so only
+        # the [n] int vector crosses to host (round 2 pulled the whole
+        # design matrix); plain numpy argmax for host labels.
+        if isinstance(labels, jax.Array):
+            class_idx = np.asarray(jnp.argmax(labels[:n], axis=1))
+        else:
+            class_idx = np.argmax(np.asarray(labels)[:n], axis=1)
         counts_np = np.bincount(class_idx, minlength=n_classes)
         if np.any(counts_np == 0):
             missing = np.nonzero(counts_np == 0)[0]
             raise ValueError(f"classes with no examples: {missing.tolist()}")
 
-        # Host-side class grouping: stable sort by class (the reference's
-        # HashPartitioner shuffle + per-partition id sort, :324-361).
+        # Class grouping (the reference's HashPartitioner shuffle +
+        # per-partition id sort, :324-361): a host argsort of the [n] class
+        # vector gives the permutation; rows move device-side via one gather
+        # per block below.
         order = np.argsort(class_idx, kind="stable")
         starts_np = np.concatenate([[0], np.cumsum(counts_np)[:-1]])
         n_max = int(counts_np.max())
 
         if isinstance(features, (list, tuple)):
-            blocks = [jnp.asarray(np.asarray(b)[order]) for b in features]
+            blocks = list(features)
         else:
-            feats_sorted = np.asarray(features)[order]
-            blocks = VectorSplitter(self.block_size, num_features)(feats_sorted)
-            blocks = [jnp.asarray(b) for b in blocks]
+            blocks = list(VectorSplitter(self.block_size, num_features)(features))
 
-        dtype = blocks[0].dtype
+        dtype = jnp.asarray(blocks[0][:1]).dtype
         w = self.mixture_weight
-        labels_sorted = jnp.asarray(labels_np[order], dtype)
+
+        # Padded row layout: sorted valid rows, then a zero tail of >= n_max
+        # rows (so every dynamic_slice in the class sweep stays in bounds).
+        # The zero tail contributes nothing to gemms/sums, so population
+        # statistics use xb_pad directly with the true count n.  With a mesh
+        # the tail additionally rounds the row count up to a data-axis
+        # multiple and the padded blocks are row-sharded: population
+        # gram/XᵀR gemms lower to local gram + ICI all-reduce.
+        pad_total = n_max
+        row_shard = None
+        if mesh is not None:
+            d_size = mesh.shape[DATA_AXIS]
+            pad_total += (-(n + n_max)) % d_size
+            row_shard = NamedSharding(mesh, P(DATA_AXIS, None))
+        p_tot = n + pad_total
+
+        # gather index: order for valid rows, then an out-of-range index so
+        # ``mode="fill"`` writes exact zero rows for the tail — the sort and
+        # the padding are a single device gather, no host round-trip.
+        gather_np = np.concatenate(
+            [order, np.full(pad_total, n, dtype=order.dtype)]
+        )
+        gather_idx = jnp.asarray(gather_np)
+        valid = jnp.asarray((gather_np < n).astype(np.float32))[:, None]
+
+        def sort_pad(x):
+            """Sorted, zero-tail-padded, (re-)sharded copy of ``x``.
+
+            Host arrays are permuted host-side (no device gather at all).
+            Device-resident arrays are gathered in feature-column chunks: a
+            general gather with a replicated index over a row-sharded
+            operand makes GSPMD all-gather the operand, so chunking bounds
+            the transient unsharded slab to [p_tot, chunk] instead of the
+            full block (the one-time class shuffle costs k× optimal
+            all-to-all traffic but never exceeds chunk-slab memory).  The
+            tail is masked to exact zero either way (``mode="fill"`` covers
+            sources with exactly n rows; sources carrying their own pad
+            rows at >= n need the explicit mask).
+            """
+            if not isinstance(x, jax.Array):
+                xh = np.asarray(x)
+                out_h = np.zeros((p_tot,) + xh.shape[1:], xh.dtype)
+                out_h[:n] = xh[order]
+                out = jnp.asarray(out_h)
+                if row_shard is not None:
+                    out = jax.device_put(out, row_shard)
+                return out
+
+            chunk_cols = max(1, _GATHER_COL_CHUNK // max(1, x.itemsize))
+            outs = []
+            for c0 in range(0, x.shape[1], chunk_cols):
+                sl = jax.lax.slice_in_dim(
+                    x, c0, min(c0 + chunk_cols, x.shape[1]), axis=1
+                )
+                g = jnp.take(sl, gather_idx, axis=0, mode="fill", fill_value=0)
+                g = g * valid.astype(x.dtype)
+                if row_shard is not None:
+                    # Reshard each slab as it lands so at most one
+                    # unsharded chunk is transient at a time.
+                    g = jax.device_put(g, row_shard)
+                outs.append(g)
+            return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+
+        blocks_padded = []
+        while blocks:
+            blocks_padded.append(sort_pad(blocks.pop(0)))
+
         counts = jnp.asarray(counts_np)
         starts = jnp.asarray(starts_np)
-        class_onehot = jnp.asarray(
-            (np.arange(n_classes)[:, None] == class_idx[order][None, :]).astype(
-                labels_np.dtype
-            ),
-            dtype,
-        )  # [C, N]
+        # Segment ids: class of each sorted row, pad rows -> segment C.
+        seg_np = np.full(p_tot, n_classes, np.int32)
+        seg_np[:n] = class_idx[order]
+        seg_ids = jnp.asarray(seg_np)
+        counts_f = counts.astype(dtype)
 
         # jointLabelMean[c] = 2w + 2(1-w)·n_c/n − 1  (reference :147-149)
         joint_label_mean = jnp.asarray(
             2.0 * w + 2.0 * (1.0 - w) * counts_np / n - 1.0, dtype
         )
 
-        residual = labels_sorted - joint_label_mean
+        if isinstance(labels, jax.Array):
+            labels_sorted = sort_pad(labels.astype(dtype))
+        else:
+            labels_sorted = sort_pad(np.asarray(labels, dtype))
+        # Pad rows gathered as zero would become -jointLabelMean; mask them
+        # so the residual tail is exactly zero and stays zero (the zero
+        # feature tail adds nothing on updates).
+        res_pad = (labels_sorted - joint_label_mean) * valid.astype(dtype)
         residual_mean = _residual_class_means(
-            residual, class_onehot, counts.astype(dtype)
+            res_pad, seg_ids, counts_f, n_classes
         )
 
-        models = [jnp.zeros((b.shape[1], n_classes), dtype) for b in blocks]
-        # Keep ONLY the padded copy of each block (zero tail of >= n_max
-        # rows): the zero tail contributes nothing to gemms/sums, so
-        # population statistics use xb_pad directly with the true count n —
-        # no second full copy of the design matrix stays resident.  With a
-        # mesh the tail additionally rounds the row count up to a data-axis
-        # multiple and the padded blocks are row-sharded: population
-        # gram/XᵀR gemms lower to local gram + ICI all-reduce.
-        pad_total = n_max
-        row_sharding = None
-        if mesh is not None:
-            d_size = mesh.shape[DATA_AXIS]
-            pad_total += (-(n + n_max)) % d_size
-            row_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
-        blocks_padded = []
-        for b in blocks:
-            xb = jnp.concatenate(
-                [b, jnp.zeros((pad_total, b.shape[1]), dtype)], axis=0
-            )
-            if row_sharding is not None:
-                xb = jax.device_put(xb, row_sharding)
-            blocks_padded.append(xb)
-        del blocks
-        onehot_pad = jnp.concatenate(
-            [class_onehot, jnp.zeros((n_classes, pad_total), dtype)], axis=1
-        )
-        tail = jnp.zeros((pad_total, n_classes), dtype)
+        models = [jnp.zeros((b.shape[1], n_classes), dtype) for b in blocks_padded]
         chunk = max(1, min(self.class_chunk, n_classes))
         if mesh is not None:
             # Round the chunk up to a model-axis multiple so the batched
@@ -252,12 +332,14 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         for _pass in range(self.num_iter):
             for bi, xb_pad in enumerate(blocks_padded):
-                res_pad = jnp.concatenate([residual, tail], axis=0)
                 if block_stats[bi] is None:
                     pop_mean = jnp.sum(xb_pad, axis=0) / n
                     ata = xb_pad.T @ xb_pad
                     pop_cov = ata / n - jnp.outer(pop_mean, pop_mean)
-                    class_means = (onehot_pad @ xb_pad) / counts.astype(dtype)[:, None]
+                    class_means = (
+                        _class_sums(xb_pad, seg_ids, n_classes)
+                        / counts_f[:, None]
+                    )
                     joint_means = w * class_means + (1.0 - w) * pop_mean
                     block_stats[bi] = (pop_cov, pop_mean, joint_means)
                 else:
@@ -281,9 +363,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     mesh,
                 )
                 models[bi] = models[bi] + dw
-                residual = residual - (xb_pad @ dw)[: residual.shape[0]]
+                res_pad = res_pad - xb_pad @ dw
                 residual_mean = _residual_class_means(
-                    residual, class_onehot, counts.astype(dtype)
+                    res_pad, seg_ids, counts_f, n_classes
                 )
 
         # Intercept from joint means (reference :307-311):

@@ -73,9 +73,10 @@ def run(conf: StupidBackoffConfig, lines: list) -> dict:
         shard_sizes[shard] += 1
         if indexer.ngram_order(ngram) > 2:
             context = indexer.remove_current_word(ngram)
-            assert (
-                shard_by_initial_bigram(context, conf.num_parts, indexer) == shard
-            ), f"ngram {ngram} not co-located with context {context}"
+            if shard_by_initial_bigram(context, conf.num_parts, indexer) != shard:
+                raise ValueError(
+                    f"ngram {ngram} not co-located with context {context}"
+                )
 
     results = {
         "num_tokens": language_model.num_tokens,
