@@ -27,7 +27,7 @@ from .core.pipeline import (
     Transformer,
     transformer,
 )
-from .parallel.mesh import DistContext, make_mesh, use_mesh
+from .parallel.mesh import make_mesh, use_mesh
 
 __version__ = "0.1.0"
 
@@ -35,7 +35,6 @@ __all__ = [
     "Cacher",
     "ChainedEstimator",
     "ChainedLabelEstimator",
-    "DistContext",
     "Estimator",
     "FunctionNode",
     "FunctionTransformer",

@@ -7,10 +7,20 @@ nodes/learning/BlockWeightedLeastSquares.scala:186-216), ``broadcast``
 (BlockWeightedLeastSquares.scala:335-357) and ``collect``.  Here each maps to
 one XLA collective over the ICI fabric:
 
-  treeReduce/treeAggregate  ->  psum            (one fused all-reduce)
-  broadcast                 ->  replication / all_gather
-  partitionBy shuffle       ->  all_to_all / ppermute
-  collect                   ->  device->host transfer of an already-reduced array
+  treeReduce/treeAggregate  ->  psum (one fused all-reduce): ``sharded_gram``
+                                below, wired into the solvers
+  broadcast                 ->  implicit XLA replication of unsharded
+                                operands under jit / explicit P() shardings
+  partitionBy shuffle       ->  host sort of the small key vector + one
+                                device gather per block (the BWLS class
+                                shuffle, solvers/weighted.py) — measured
+                                simpler and no worse than a ragged
+                                all_to_all for the one-time preamble; the
+                                per-shard COO layout in
+                                solvers/naive_bayes.py is the
+                                shuffle-free scoring analog
+  collect                   ->  device->host transfer of an already-reduced
+                                array
 
 These wrappers are thin on purpose — the win is that under ``jit`` with
 sharded inputs XLA already inserts the right collective; the explicit
@@ -24,7 +34,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 from .mesh import DATA_AXIS
@@ -61,20 +71,6 @@ def sharded_gram(mesh, x, y):
     return _sharded_gram_fn(mesh)(x, y)
 
 
-def psum_moments(x_block, axis_name: str = DATA_AXIS, nvalid=None):
-    """Sharded (count, sum, sumsq): the MultivariateOnlineSummarizer analog.
-
-    Zero-padded rows contribute zero to the sums; ``nvalid`` (global true row
-    count) overrides the padded count when provided.
-    """
-    cnt = jax.lax.psum(jnp.asarray(x_block.shape[0], x_block.dtype), axis_name)
-    if nvalid is not None:
-        cnt = jnp.asarray(nvalid, x_block.dtype)
-    s = jax.lax.psum(jnp.sum(x_block, axis=0), axis_name)
-    sq = jax.lax.psum(jnp.sum(x_block * x_block, axis=0), axis_name)
-    return cnt, s, sq
-
-
 @jax.jit
 def sharded_moments_jit(x):
     """(count, Σx, Σx²) over rows.  Under jit with a row-sharded input XLA
@@ -85,29 +81,3 @@ def sharded_moments_jit(x):
     s = jnp.sum(x, axis=0)
     sq = jnp.sum(x * x, axis=0)
     return cnt, s, sq
-
-
-@functools.lru_cache(maxsize=None)
-def _all_to_all_fn(mesh, ndim: int, axis_name: str):
-    def body(xs):
-        return jax.lax.all_to_all(xs, axis_name, 0, 0, tiled=True)
-
-    spec = P(DATA_AXIS, *([None] * (ndim - 1)))
-    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec))
-
-
-def all_to_all_rows(mesh, x, axis_name: str = DATA_AXIS):
-    """Reshard rows across the data axis — the partitionBy/shuffle analog.
-
-    Each shard's rows are split into axis_size equal groups and group j is
-    delivered to device j (tiled all_to_all), so row i of the global array
-    lands on device ``(i mod per_shard) // (per_shard / k)`` — a deterministic
-    round-robin redistribution.  Requires per-shard row count divisible by the
-    axis size.
-    """
-    return _all_to_all_fn(mesh, x.ndim, axis_name)(x)
-
-
-def replicate_to(mesh, x):
-    """Broadcast analog: commit an array replicated across the mesh."""
-    return jax.device_put(x, NamedSharding(mesh, P()))

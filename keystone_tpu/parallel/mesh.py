@@ -11,7 +11,6 @@ single controller and device arrays persist in HBM between stages.
 from __future__ import annotations
 
 import contextlib
-from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -36,10 +35,6 @@ def make_mesh(data: int | None = None, model: int = 1, devices=None) -> Mesh:
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 
-def single_device_mesh() -> Mesh:
-    return make_mesh(data=1, model=1)
-
-
 _current_mesh: list[Mesh] = []
 
 
@@ -60,27 +55,6 @@ def current_mesh() -> Mesh | None:
 def row_sharding(mesh: Mesh) -> NamedSharding:
     """Examples sharded over the data axis; features replicated (the RDD analog)."""
     return NamedSharding(mesh, P(DATA_AXIS))
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
-
-
-def model_sharding(mesh: Mesh, axis: int = 1, ndim: int = 2) -> NamedSharding:
-    """Shard a parameter array over the model axis along ``axis``."""
-    spec = [None] * ndim
-    spec[axis] = MODEL_AXIS
-    return NamedSharding(mesh, P(*spec))
-
-
-def shard_rows(x, mesh: Mesh | None = None):
-    """Place a [N, ...] array row-sharded on the mesh's data axis.
-    N must be divisible by the data-axis size; otherwise use
-    :func:`padded_shard_rows`."""
-    mesh = mesh or current_mesh()
-    if mesh is None:
-        return jax.device_put(x)
-    return jax.device_put(x, row_sharding(mesh))
 
 
 def padded_shard_rows(x, mesh: Mesh | None = None):
@@ -156,26 +130,3 @@ def pad_shard_inputs(mesh, nvalid: int | None, *arrays):
     if out and out[0].shape[0] != n_true:
         nvalid = n_true
     return out, nvalid
-
-
-@dataclass(frozen=True)
-class DistContext:
-    """Bundle of mesh + canonical shardings threaded through solvers."""
-
-    mesh: Mesh
-
-    @property
-    def rows(self) -> NamedSharding:
-        return row_sharding(self.mesh)
-
-    @property
-    def repl(self) -> NamedSharding:
-        return replicated(self.mesh)
-
-    @property
-    def n_data(self) -> int:
-        return self.mesh.shape[DATA_AXIS]
-
-    @property
-    def n_model(self) -> int:
-        return self.mesh.shape[MODEL_AXIS]

@@ -17,6 +17,7 @@ from ..ops.fisher import FisherVector
 from ..ops.images import GrayScaler, PixelScaler
 from ..ops.stats import NormalizeRows, SignedHellingerMapper
 from ..ops.util import MatrixVectorizer
+from ..parallel.mesh import padded_shard_rows
 from ..solvers.gmm import GaussianMixtureModel
 
 
@@ -29,6 +30,18 @@ def bucket_by_shape(images: list) -> dict:
         shape: (np.asarray(idx), np.stack([images[i] for i in idx]))
         for shape, idx in groups.items()
     }
+
+
+def shard_batch(batch, mesh):
+    """Row-shard one bucket's [n, H, W, C] batch over the mesh's data axis
+    (zero-padding n up to an axis multiple), or plain device_put without a
+    mesh.  Pad rows ride through the per-image featurizers as garbage rows
+    and are dropped at scatter time (``scatter_features`` slices to the
+    bucket's true image count) and at sampling time (``sample_columns``
+    samples only valid images) — the bucket featurize program itself is
+    purely data-parallel, so no masking is needed in between."""
+    dev, _n = padded_shard_rows(np.asarray(batch), mesh)
+    return dev
 
 
 def grayscale(batch) -> jnp.ndarray:
@@ -44,14 +57,16 @@ def sample_columns(desc_buckets: dict, num_samples: int, seed: int = 42) -> jnp.
     columns are materialized — never the full descriptor set (the reference
     ColumnSampler likewise samples per image, Sampling.scala:12-22)."""
     rng = np.random.default_rng(seed)
+    # valid image count is len(idx) — descriptor arrays may carry sharding
+    # pad rows past it (see shard_batch) which must never be sampled
     totals = {
-        shape: descs.shape[0] * descs.shape[2]
-        for shape, (_, descs) in desc_buckets.items()
+        shape: len(idx) * descs.shape[2]
+        for shape, (idx, descs) in desc_buckets.items()
     }
     grand_total = sum(totals.values())
     picks = []
-    for shape, (_, descs) in desc_buckets.items():
-        n, d, c = descs.shape
+    for shape, (idx_arr, descs) in desc_buckets.items():
+        n, d, c = len(idx_arr), descs.shape[1], descs.shape[2]
         total = totals[shape]
         if grand_total <= num_samples:
             quota = total
@@ -85,5 +100,7 @@ def scatter_features(buckets: dict, transform, n_total: int, feature_dim: int) -
     bucket and scatter rows back to original image order."""
     out = np.zeros((n_total, feature_dim), np.float32)
     for _shape, (idx, descs) in buckets.items():
-        out[np.asarray(idx)] = np.asarray(transform(descs))
+        # slice off sharding pad rows (see shard_batch): only the bucket's
+        # true images scatter back
+        out[np.asarray(idx)] = np.asarray(transform(descs))[: len(idx)]
     return out

@@ -25,6 +25,7 @@ from ..ops.lcs import LCSExtractor
 from ..ops.sift import SIFTExtractor
 from ..ops.stats import SignedHellingerMapper
 from ..ops.util import ClassLabelIndicatorsFromIntLabels, TopKClassifier
+from ..parallel.mesh import parse_mesh
 from ..solvers.gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
 from ..solvers.pca import BatchPCATransformer, compute_pca
 from ..solvers.weighted import BlockWeightedLeastSquaresEstimator
@@ -35,6 +36,7 @@ from .fv_common import (
     grayscale,
     sample_columns,
     scatter_features,
+    shard_batch,
 )
 
 # Hard cap on the GMM EM training set (reference ImageNetSiftLcsFV.scala:85-86).
@@ -110,22 +112,27 @@ def _fit_branch(conf: ImageNetSiftLcsFVConfig, desc_buckets: dict, pca_file, gmm
     return batch_pca, fisher_feature_pipeline(gmm), pca_desc
 
 
-def sift_descriptor_buckets(conf: ImageNetSiftLcsFVConfig, images: list) -> dict:
-    """SIFT branch descriptors (:40-94): SIFT -> BatchSignedHellinger."""
+def sift_descriptor_buckets(
+    conf: ImageNetSiftLcsFVConfig, images: list, mesh=None
+) -> dict:
+    """SIFT branch descriptors (:40-94): SIFT -> BatchSignedHellinger.
+    With a mesh each bucket batch is row-sharded over the data axis."""
     sift = SIFTExtractor(scale_step=conf.sift_scale_step)
     hell = SignedHellingerMapper()
     buckets = {}
     for shape, (idx, batch) in bucket_by_shape(images).items():
-        gray = grayscale(batch)
+        gray = grayscale(shard_batch(batch, mesh))
         buckets[shape] = (idx, hell(sift(gray)))
     return buckets
 
 
-def lcs_descriptor_buckets(conf: ImageNetSiftLcsFVConfig, images: list) -> dict:
+def lcs_descriptor_buckets(
+    conf: ImageNetSiftLcsFVConfig, images: list, mesh=None
+) -> dict:
     """LCS branch descriptors (:96-148): raw LCS straight into PCA."""
     lcs = LCSExtractor(conf.lcs_stride, conf.lcs_border, conf.lcs_patch)
     return {
-        shape: (idx, lcs(jnp.asarray(batch)))
+        shape: (idx, lcs(shard_batch(batch, mesh)))
         for shape, (idx, batch) in bucket_by_shape(images).items()
     }
 
@@ -138,9 +145,10 @@ def branch_features(
     pca_file,
     gmm_files,
     seed: int,
+    mesh=None,
 ):
     """Fit transformers on train, apply to train AND test."""
-    train_desc = descriptor_fn(conf, train_images)
+    train_desc = descriptor_fn(conf, train_images, mesh)
     batch_pca, fisher, train_pca_desc = _fit_branch(
         conf, train_desc, pca_file, gmm_files, seed
     )
@@ -148,14 +156,24 @@ def branch_features(
     train_feats = scatter_features(
         train_pca_desc, fisher, len(train_images), feat_dim
     )
-    test_desc = descriptor_fn(conf, test_images)
+    test_desc = descriptor_fn(conf, test_images, mesh)
     test_feats = scatter_features(
         test_desc, lambda d: fisher(batch_pca(d)), len(test_images), feat_dim
     )
     return train_feats, test_feats
 
 
-def run(conf: ImageNetSiftLcsFVConfig, train: LabeledImages, test: LabeledImages) -> dict:
+def run(
+    conf: ImageNetSiftLcsFVConfig,
+    train: LabeledImages,
+    test: LabeledImages,
+    mesh=None,
+) -> dict:
+    """With ``mesh``: featurization buckets are row-sharded over the data
+    axis and the 2·2·descDim·vocabSize-feature class-weighted solve runs
+    distributed — row-sharded population grams with ICI all-reduce and
+    model-axis-sharded batched class solves (the reference runs this over
+    partitioned RDDs + treeReduce, ImageNetSiftLcsFV.scala:150-195)."""
     configure_logging()
     log = _Log()
     t0 = time.perf_counter()
@@ -168,6 +186,7 @@ def run(conf: ImageNetSiftLcsFVConfig, train: LabeledImages, test: LabeledImages
         conf.sift_pca_file,
         (conf.sift_gmm_mean_file, conf.sift_gmm_var_file, conf.sift_gmm_wts_file),
         conf.seed,
+        mesh,
     )
     train_lcs, test_lcs = branch_features(
         conf,
@@ -177,17 +196,18 @@ def run(conf: ImageNetSiftLcsFVConfig, train: LabeledImages, test: LabeledImages
         conf.lcs_pca_file,
         (conf.lcs_gmm_mean_file, conf.lcs_gmm_var_file, conf.lcs_gmm_wts_file),
         conf.seed + 100,
+        mesh,
     )
 
-    # ZipVectors (:179-183)
-    train_features = jnp.asarray(np.concatenate([train_sift, train_lcs], axis=1))
+    # ZipVectors (:179-183) — kept host-side; the solver shards its blocks
+    train_features = np.concatenate([train_sift, train_lcs], axis=1)
     test_features = jnp.asarray(np.concatenate([test_sift, test_lcs], axis=1))
 
     labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
 
     # 2·2·descDim·vocabSize features (:186-188)
     model = BlockWeightedLeastSquaresEstimator(
-        4096, 1, conf.lam, conf.mixture_weight
+        4096, 1, conf.lam, conf.mixture_weight, mesh=mesh
     ).fit(train_features, labels, num_features=2 * 2 * conf.desc_dim * conf.vocab_size)
 
     test_scores = model(test_features)
@@ -219,6 +239,11 @@ def main(argv=None):
     p.add_argument("--numPcaSamples", type=int, default=int(1e7))
     p.add_argument("--numGmmSamples", type=int, default=int(1e7))
     p.add_argument("--numClasses", type=int, default=1000)
+    p.add_argument(
+        "--mesh",
+        default=None,
+        help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
+    )
     for flag in (
         "siftPcaFile", "siftGmmMeanFile", "siftGmmVarFile", "siftGmmWtsFile",
         "lcsPcaFile", "lcsGmmMeanFile", "lcsGmmVarFile", "lcsGmmWtsFile",
@@ -251,7 +276,7 @@ def main(argv=None):
     )
     train = imagenet_loader(conf.train_location, conf.label_path)
     test = imagenet_loader(conf.test_location, conf.label_path)
-    return run(conf, train, test)
+    return run(conf, train, test, mesh=parse_mesh(a.mesh))
 
 
 if __name__ == "__main__":

@@ -9,6 +9,7 @@ MulticlassClassifierEvaluator (pretty summary per class).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import time
 
 from dataclasses import dataclass
@@ -21,6 +22,7 @@ from ..loaders.newsgroups import CLASSES, NewsgroupsData, newsgroups_loader
 from ..ops.nlp import LowerCase, NGramsFeaturizer, TermFrequency, Tokenizer, Trim
 from ..ops.sparse import CommonSparseFeatures
 from ..ops.util import MaxClassifier
+from ..parallel.mesh import parse_mesh, use_mesh
 from ..solvers.naive_bayes import NaiveBayesEstimator
 
 
@@ -39,11 +41,23 @@ class _Log(Logging):
     pass
 
 
-def run(conf: NewsgroupsConfig, train: NewsgroupsData, test: NewsgroupsData) -> dict:
+def run(
+    conf: NewsgroupsConfig,
+    train: NewsgroupsData,
+    test: NewsgroupsData,
+    mesh=None,
+) -> dict:
+    """With ``mesh``: naive-Bayes scoring runs data-parallel over the mesh —
+    per-device COO shards contracted against the replicated ``theta`` under
+    ``shard_map`` (see NaiveBayesModel._apply_csr_mesh).  The text
+    featurization and the NB count aggregation stay host-side, like the
+    reference's per-executor text processing feeding MLlib
+    (NewsgroupsPipeline.scala:14-75)."""
     configure_logging()
     log = _Log()
     t0 = time.perf_counter()
     num_classes = len(conf.classes)
+    mesh_ctx = use_mesh(mesh) if mesh is not None else contextlib.nullcontext()
 
     log.log_info("Training classifier")
     text_pipe = (
@@ -60,7 +74,8 @@ def run(conf: NewsgroupsConfig, train: NewsgroupsData, test: NewsgroupsData) -> 
 
     log.log_info("Evaluating classifier")
     test_feats = vectorizer(text_pipe(test.data))
-    predictions = np.asarray(MaxClassifier()(model(test_feats)))
+    with mesh_ctx:
+        predictions = np.asarray(MaxClassifier()(model(test_feats)))
     ev = MulticlassClassifierEvaluator(predictions, test.labels, num_classes)
     results = {
         "test_error": 100.0 * ev.total_error,
@@ -77,6 +92,11 @@ def main(argv=None):
     p.add_argument("--testLocation", required=True)
     p.add_argument("--nGrams", type=int, default=2)
     p.add_argument("--commonFeatures", type=int, default=100000)
+    p.add_argument(
+        "--mesh",
+        default=None,
+        help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
+    )
     a = p.parse_args(argv)
     conf = NewsgroupsConfig(
         train_location=a.trainLocation,
@@ -86,7 +106,7 @@ def main(argv=None):
     )
     train = newsgroups_loader(conf.train_location)
     test = newsgroups_loader(conf.test_location)
-    return run(conf, train, test)
+    return run(conf, train, test, mesh=parse_mesh(a.mesh))
 
 
 if __name__ == "__main__":

@@ -23,6 +23,7 @@ from ..evaluation.map import MeanAveragePrecisionEvaluator
 from ..loaders.image_loaders import VOC_NUM_CLASSES, MultiLabeledImages, voc_loader
 from ..ops.sift import SIFTExtractor
 from ..ops.util import ClassLabelIndicatorsFromIntArrayLabels
+from ..parallel.mesh import parse_mesh
 from ..solvers.block import BlockLeastSquaresEstimator
 from ..solvers.gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
 from ..solvers.pca import BatchPCATransformer, compute_pca
@@ -32,6 +33,7 @@ from .fv_common import (
     grayscale,
     sample_columns,
     scatter_features,
+    shard_batch,
 )
 
 
@@ -60,17 +62,30 @@ class _Log(Logging):
     pass
 
 
-def extract_sift_buckets(conf: SIFTFisherConfig, images: list) -> dict:
-    """Per shape bucket: grayscale + dense SIFT -> [n, 128, cols]."""
+def extract_sift_buckets(
+    conf: SIFTFisherConfig, images: list, mesh=None
+) -> dict:
+    """Per shape bucket: grayscale + dense SIFT -> [n, 128, cols].  With a
+    mesh each bucket batch is row-sharded over the data axis so the SIFT
+    program runs data-parallel (pad rows are dropped downstream)."""
     sift = SIFTExtractor(step_size=conf.sift_step_size, scale_step=conf.scale_step)
     out = {}
     for shape, (idx, batch) in bucket_by_shape(images).items():
-        gray = grayscale(batch)
+        gray = grayscale(shard_batch(batch, mesh))
         out[shape] = (idx, sift(gray))
     return out
 
 
-def run(conf: SIFTFisherConfig, train: MultiLabeledImages, test: MultiLabeledImages) -> dict:
+def run(
+    conf: SIFTFisherConfig,
+    train: MultiLabeledImages,
+    test: MultiLabeledImages,
+    mesh=None,
+) -> dict:
+    """With ``mesh``: featurization buckets are row-sharded over the data
+    axis and the block least-squares solve runs distributed ((data, model)
+    shardings via the ambient mesh) — the analog of the reference running
+    this pipeline over partitioned RDDs (VOCSIFTFisher.scala:18-111)."""
     configure_logging()
     log = _Log()
     t0 = time.perf_counter()
@@ -79,7 +94,7 @@ def run(conf: SIFTFisherConfig, train: MultiLabeledImages, test: MultiLabeledIma
     train_labels = label_node(train.labels)
 
     # Part 1+2: SIFT descriptors per shape bucket (reference :36-57)
-    train_desc = extract_sift_buckets(conf, train.images)
+    train_desc = extract_sift_buckets(conf, train.images, mesh)
 
     # Part 1a: PCA — fit on sampled descriptor columns, or load (:40-50)
     if conf.pca_file is not None:
@@ -111,13 +126,13 @@ def run(conf: SIFTFisherConfig, train: MultiLabeledImages, test: MultiLabeledIma
         scatter_features(pca_desc, fisher, len(train), feat_dim)
     )
 
-    # Part 4: linear model (:84-86)
-    model = BlockLeastSquaresEstimator(4096, 1, conf.lam).fit(
+    # Part 4: linear model (:84-86) — mesh-distributed when given one
+    model = BlockLeastSquaresEstimator(4096, 1, conf.lam, mesh=mesh).fit(
         train_features, train_labels, num_features=feat_dim
     )
 
     # Test path (:92-106)
-    test_desc = extract_sift_buckets(conf, test.images)
+    test_desc = extract_sift_buckets(conf, test.images, mesh)
     test_features = scatter_features(
         test_desc, lambda d: fisher(batch_pca(d)), len(test), feat_dim
     )
@@ -149,6 +164,11 @@ def main(argv=None):
     p.add_argument("--gmmWtsFile", default=None)
     p.add_argument("--numPcaSamples", type=int, default=int(1e6))
     p.add_argument("--numGmmSamples", type=int, default=int(1e6))
+    p.add_argument(
+        "--mesh",
+        default=None,
+        help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
+    )
     a = p.parse_args(argv)
     conf = SIFTFisherConfig(
         train_location=a.trainLocation,
@@ -167,7 +187,7 @@ def main(argv=None):
     )
     train = voc_loader(conf.train_location, conf.label_path)
     test = voc_loader(conf.test_location, conf.label_path)
-    return run(conf, train, test)
+    return run(conf, train, test, mesh=parse_mesh(a.mesh))
 
 
 if __name__ == "__main__":

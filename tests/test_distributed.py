@@ -90,6 +90,29 @@ def test_bwls_mesh42_matches_local(rng, mesh42):
     )
 
 
+def test_bwls_device_sharded_inputs_match_local(rng, mesh42):
+    """fit() fed row-sharded device arrays + nvalid (the workload path —
+    no host round-trip) must match the host-input single-device fit."""
+    from keystone_tpu.parallel.mesh import padded_shard_rows
+
+    n, d, k = 117, 16, 4  # n deliberately not divisible by the data axis
+    labels_int = rng.integers(0, k, size=n)
+    feats = rng.normal(size=(n, d)).astype(np.float32)
+    labels = (2.0 * np.eye(k)[labels_int] - 1.0).astype(np.float32)
+    est = dict(block_size=8, num_iter=2, lam=0.1, mixture_weight=0.4)
+    local = BlockWeightedLeastSquaresEstimator(**est, class_chunk=1).fit(
+        feats, labels
+    )
+    feats_dev, nvalid = padded_shard_rows(feats, mesh42)
+    labels_dev, _ = padded_shard_rows(labels, mesh42)
+    sharded = BlockWeightedLeastSquaresEstimator(
+        **est, class_chunk=4, mesh=mesh42
+    ).fit(feats_dev, labels_dev, nvalid=nvalid)
+    for lm, sm in zip(local.xs, sharded.xs):
+        assert about_eq(np.asarray(sm), np.asarray(lm), 1e-3)
+    assert about_eq(np.asarray(sharded.b), np.asarray(local.b), 1e-3)
+
+
 def test_graft_dryrun_impl_in_process(devices):
     """The driver's multi-chip dryrun must drive the real solver path."""
     import os

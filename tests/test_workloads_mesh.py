@@ -6,18 +6,28 @@ must reproduce the single-device result on the virtual 8-device platform.
 """
 
 import numpy as np
+import pytest
 
 from keystone_tpu.loaders.cifar import cifar_loader
 from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.loaders.image_loaders import imagenet_loader, voc_loader
+from keystone_tpu.loaders.newsgroups import newsgroups_loader
 from keystone_tpu.loaders.timit import timit_features_loader
 from keystone_tpu.workloads.cifar_random_patch import RandomCifarConfig
 from keystone_tpu.workloads.cifar_random_patch import run as cifar_run
+from keystone_tpu.workloads.imagenet_sift_lcs_fv import ImageNetSiftLcsFVConfig
+from keystone_tpu.workloads.imagenet_sift_lcs_fv import run as imagenet_run
 from keystone_tpu.workloads.mnist_random_fft import MnistRandomFFTConfig
 from keystone_tpu.workloads.mnist_random_fft import run as mnist_run
+from keystone_tpu.workloads.newsgroups import NewsgroupsConfig
+from keystone_tpu.workloads.newsgroups import run as newsgroups_run
 from keystone_tpu.workloads.timit import TimitConfig
 from keystone_tpu.workloads.timit import run as timit_run
+from keystone_tpu.workloads.voc_sift_fisher import SIFTFisherConfig
+from keystone_tpu.workloads.voc_sift_fisher import run as voc_run
 
 from test_cifar_pipeline import write_synthetic_cifar
+from test_fisher_pipelines import write_imagenet_tar, write_voc_tar
 from test_timit import write_split
 
 
@@ -81,3 +91,78 @@ def test_cifar_random_patch_mesh_matches_local(rng, mesh8, tmp_path):
     sharded = cifar_run(conf, train, test, mesh=mesh8)
     assert abs(sharded["train_error"] - local["train_error"]) < 1.1
     assert abs(sharded["test_error"] - local["test_error"]) < 1.1
+
+
+@pytest.mark.slow
+def test_imagenet_sift_lcs_fv_mesh_matches_local(rng, mesh42, tmp_path):
+    """The north-star FV -> BWLS tail, sharded == local: featurization
+    buckets row-sharded over the data axis, the class-weighted solve over
+    the (data, model) mesh (reference ImageNetSiftLcsFV.scala:150-195)."""
+    labels_path = str(tmp_path / "labels.txt")
+    write_imagenet_tar(str(tmp_path), labels_path, rng)
+    data = imagenet_loader(str(tmp_path), labels_path)
+    conf = ImageNetSiftLcsFVConfig(
+        lam=1e-3,
+        mixture_weight=0.25,
+        desc_dim=12,
+        vocab_size=4,
+        num_pca_samples=4000,
+        num_gmm_samples=4000,
+        lcs_stride=8,
+        lcs_border=16,
+        lcs_patch=6,
+        num_classes=3,
+    )
+    local = imagenet_run(conf, data, data)
+    sharded = imagenet_run(conf, data, data, mesh=mesh42)
+    # 24 images quantize the error to 1/24 steps; identical fits (same
+    # seeds, same sampled columns — pad rows never sampled) must land on
+    # the same step.
+    assert sharded["top1_err_percent"] == local["top1_err_percent"]
+    assert sharded["top5_err_percent"] == local["top5_err_percent"]
+
+
+@pytest.mark.slow
+def test_voc_sift_fisher_mesh_matches_local(rng, mesh8, tmp_path):
+    labels_csv = str(tmp_path / "labels.csv")
+    open(labels_csv, "w").close()
+    write_voc_tar(str(tmp_path / "train.tar"), labels_csv, 24, rng)
+    data = voc_loader(str(tmp_path / "train.tar"), labels_csv)
+    conf = SIFTFisherConfig(
+        lam=0.05,
+        desc_dim=16,
+        vocab_size=8,
+        num_pca_samples=6000,
+        num_gmm_samples=6000,
+        sift_step_size=6,
+    )
+    local = voc_run(conf, data, data)
+    sharded = voc_run(conf, data, data, mesh=mesh8)
+    assert np.allclose(sharded["aps"], local["aps"], atol=1e-6), (
+        sharded["aps"],
+        local["aps"],
+    )
+
+
+def test_newsgroups_mesh_matches_local(rng, mesh8, tmp_path):
+    """Mesh NB scoring (shard_map COO contraction) == serial scoring."""
+    themes = {
+        "comp.graphics": ["pixel", "render", "shader", "gpu", "image"],
+        "rec.autos": ["engine", "car", "wheel", "drive", "motor"],
+        "sci.space": ["orbit", "rocket", "nasa", "launch", "moon"],
+    }
+    for split in ("train", "test"):
+        for cls, words in themes.items():
+            d = tmp_path / split / cls
+            d.mkdir(parents=True)
+            # test count 7 -> 21 docs, NOT divisible by the 8-way axis
+            for i in range(10 if split == "train" else 7):
+                body = " ".join(rng.choice(words, 25).tolist())
+                (d / f"doc{i}.txt").write_text(body)
+    classes = tuple(themes)
+    train = newsgroups_loader(str(tmp_path / "train"), list(classes))
+    test = newsgroups_loader(str(tmp_path / "test"), list(classes))
+    conf = NewsgroupsConfig(n_grams=2, common_features=3000, classes=classes)
+    local = newsgroups_run(conf, train, test)
+    sharded = newsgroups_run(conf, train, test, mesh=mesh8)
+    assert abs(sharded["test_error"] - local["test_error"]) < 1e-9
