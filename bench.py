@@ -35,13 +35,6 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def force(x) -> float:
-    """Drain the device queue: a scalar host pull is the only reliable sync
-    on tunneled platforms where ``block_until_ready`` can return early."""
-    return float(jnp.sum(jax.tree_util.tree_reduce(
-        lambda a, b: a + jnp.sum(b), jax.tree_util.tree_leaves(x), jnp.float32(0)
-    ))) if not hasattr(x, "sum") else float(jnp.sum(x))
-
 from keystone_tpu.ops.fisher import FisherVector
 from keystone_tpu.ops.sift import SIFTExtractor
 from keystone_tpu.solvers.block import BlockLeastSquaresEstimator
@@ -65,10 +58,13 @@ PEAK_FLOPS = {
 }
 
 
-def compiled_flops(fn, *args) -> float | None:
-    """Total FLOPs of the compiled program from XLA's cost analysis."""
+def compiled_flops(jitted_fn, *args) -> float | None:
+    """Total FLOPs of the compiled program from XLA's cost analysis.
+
+    Takes the already-jitted wrapper so lowering hits the jit cache instead
+    of tracing and compiling the program a second time."""
     try:
-        analysis = jax.jit(fn).lower(*args).compile().cost_analysis()
+        analysis = jitted_fn.lower(*args).compile().cost_analysis()
         if isinstance(analysis, (list, tuple)):
             analysis = analysis[0]
         return float(analysis.get("flops", 0.0)) or None
@@ -129,7 +125,7 @@ def bench_cifar_featurize(rng):
     out.block_until_ready()
     dt = time.perf_counter() - t0
 
-    flops = compiled_flops(conv_pipe.__call__, batch)
+    flops = compiled_flops(feat_fn, batch)
     images_per_sec = n_bench * iters / dt
     flops_per_sec = flops * iters / dt if flops else None
 
@@ -140,8 +136,12 @@ def bench_cifar_featurize(rng):
         jnp.float32,
     )
     t1 = time.perf_counter()
-    BlockLeastSquaresEstimator(4096, num_iter=1, lam=10.0).fit(feats, labels)
-    jax.effects_barrier()
+    model = BlockLeastSquaresEstimator(4096, num_iter=1, lam=10.0).fit(
+        feats, labels
+    )
+    # fit returns unsynced device arrays; wait for the actual solve, not
+    # just its dispatch, before stopping the clock
+    jax.block_until_ready((model.xs, model.b))
     solve_secs = time.perf_counter() - t1
 
     return {
@@ -184,7 +184,7 @@ def bench_imagenet_fv_featurize(rng):
     out.block_until_ready()
     dt = time.perf_counter() - t0
 
-    flops = compiled_flops(featurize, batch)
+    flops = compiled_flops(fn, batch)
     return {
         "images_per_sec": n_bench * iters / dt,
         "flops_per_sec": flops * iters / dt if flops else None,

@@ -1,0 +1,192 @@
+"""Golden-fixture tests against the reference's own shipped artifacts
+(reference EncEvalSuite.scala:14-40, VLFeatSuite.scala:12-52).
+
+The reference checkout ships a real VOC GMM codebook
+(src/test/resources/images/voc_codebook/{means.csv,variances.csv,priors})
+and a real VOC image (images/000012.jpg).  Its golden CSV dumps
+(`images/feats.csv`, `images/feats128.csv` — the MATLAB vl_phow outputs the
+suites compare against) are NOT present in the checkout, so the exact
+FV-sum constant (40.109097, EncEvalSuite.scala:38) and the +/-1/99.5% SIFT
+envelope (VLFeatSuite.scala:48-51) cannot be reproduced here.  What CAN be
+grounded on the real artifacts, and is below:
+
+* the GMM loader reads the real codebook files byte-for-byte
+  (format parity with GaussianMixtureModel.scala:83-90);
+* dense SIFT runs on the real image at the reference suite's exact
+  parameters (stepSize=3, binSize=4, 4 scales, scaleStep=0 —
+  VLFeatSuite.scala:19-26) and satisfies every property the kernel
+  contract promises (count formula, 128-dim, quantization range,
+  low-contrast zeroing);
+* the vectorized Fisher-vector encoder agrees with an independent float64
+  NumPy transcription of the enceval formulas on REAL descriptors encoded
+  against the REAL codebook (not synthetic data).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders.image_loaders import decode_image
+from keystone_tpu.ops.fisher import fisher_vector
+from keystone_tpu.ops.images import GrayScaler, PixelScaler
+from keystone_tpu.ops.sift import DESC_DIM, SIFTExtractor
+from keystone_tpu.solvers.gmm import GaussianMixtureModel
+from keystone_tpu.solvers.pca import compute_pca
+
+REF_IMG = "/root/reference/src/test/resources/images"
+CODEBOOK = f"{REF_IMG}/voc_codebook"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(CODEBOOK), reason="reference fixtures absent"
+)
+
+
+def load_codebook() -> GaussianMixtureModel:
+    return GaussianMixtureModel.load(
+        f"{CODEBOOK}/means.csv", f"{CODEBOOK}/variances.csv", f"{CODEBOOK}/priors"
+    )
+
+
+def real_image_gray() -> np.ndarray:
+    """000012.jpg -> [1, H, W, 1] grayscale in [0, 1], the exact preprocessing
+    of VLFeatSuite.scala:13-15 (mapPixels(_/255) then toGrayScale)."""
+    raw = decode_image(open(f"{REF_IMG}/000012.jpg", "rb").read())
+    batch = raw[None]  # [1, H, W, 3] BGR in [0, 255]
+    return np.asarray(GrayScaler()(PixelScaler()(batch)))
+
+
+class TestVocCodebook:
+    def test_loads_real_codebook(self):
+        """Format parity with GaussianMixtureModel.load (scala :83-90): the
+        VOC codebook is 80-dim (PCA'd SIFT) x 256 centers; priors one value
+        per line."""
+        gmm = load_codebook()
+        assert gmm.dim == 80
+        assert gmm.k == 256
+        w = np.asarray(gmm.weights)
+        assert abs(w.sum() - 1.0) < 1e-3
+        assert (w > 0).all()
+        assert (np.asarray(gmm.variances) > 0).all()
+        assert np.isfinite(np.asarray(gmm.means)).all()
+
+
+class TestSiftRealImage:
+    """VLFeatSuite.scala:12-52 analog on the real image, real parameters."""
+
+    PARAMS = dict(step_size=3, bin_size=4, scales=4, scale_step=0)
+
+    def test_descriptor_grid_and_quantization(self):
+        gray = real_image_gray()
+        ext = SIFTExtractor(**self.PARAMS)
+        descs = np.asarray(ext(gray))  # [1, 128, D]
+        n, d, cols = descs.shape
+        assert n == 1 and d == DESC_DIM
+        # "Resulting SIFTs must be 128-dimensional" + the count is exactly
+        # the multi-scale keypoint-grid formula (VLFeat.cxx:93-108)
+        assert cols == ext.num_descriptors(gray.shape[1], gray.shape[2])
+        assert cols > 10_000  # a 333x500 image yields a dense grid
+        # quantization contract: min(floor(512 v), 255) as integers in [0,255]
+        assert descs.min() >= 0.0 and descs.max() <= 255.0
+        assert np.all(descs == np.floor(descs))
+        assert descs.max() > 64  # real image energy actually lands in bins
+
+    def test_low_contrast_zeroing_on_real_image(self):
+        """Descriptors in flat regions (sky) are zeroed by the contrast
+        threshold (VLFeat.cxx:167-169); textured regions are not.  On this
+        image the overwhelming majority of the dense grid is textured."""
+        gray = real_image_gray()
+        descs = np.asarray(SIFTExtractor(**self.PARAMS)(gray))[0]  # [128, D]
+        norms = np.linalg.norm(descs, axis=0)
+        nonzero_frac = float((norms > 0).mean())
+        assert nonzero_frac > 0.5
+        # zeroed columns are exactly zero, not merely small
+        zeroed = descs[:, norms == 0]
+        assert zeroed.size == 0 or np.all(zeroed == 0)
+
+
+class TestFisherVectorRealData:
+    """EncEvalSuite.scala:14-40 analog: encode real descriptors of the real
+    image against the real VOC codebook; verify the vectorized encoder
+    against an independent float64 transcription of the enceval formulas
+    (gmm-fisher fisher.cxx mean/variance gradients, alpha=1, pnorm=0)."""
+
+    @staticmethod
+    def naive_fv64(x, means, variances, weights):
+        """Independent NumPy float64 FV: explicit per-descriptor loop."""
+        x = x.astype(np.float64)
+        means = means.astype(np.float64)
+        variances = variances.astype(np.float64)
+        weights = weights.astype(np.float64)
+        n, d = x.shape
+        k = means.shape[1]
+        sigma = np.sqrt(variances)
+        # posteriors, numerically stable
+        log_pdf = np.empty((n, k))
+        for j in range(k):
+            u = (x - means[:, j]) / sigma[:, j]
+            log_pdf[:, j] = (
+                -0.5 * np.sum(u * u, axis=1)
+                - np.sum(np.log(sigma[:, j]))
+                - 0.5 * d * np.log(2 * np.pi)
+                + np.log(weights[j])
+            )
+        log_norm = log_pdf.max(axis=1, keepdims=True)
+        q = np.exp(log_pdf - log_norm)
+        q /= q.sum(axis=1, keepdims=True)
+        g_mean = np.zeros((d, k))
+        g_var = np.zeros((d, k))
+        for i in range(n):
+            for j in range(k):
+                u = (x[i] - means[:, j]) / sigma[:, j]
+                g_mean[:, j] += q[i, j] * u
+                g_var[:, j] += q[i, j] * (u * u - 1.0)
+        g_mean /= n * np.sqrt(weights)
+        g_var /= n * np.sqrt(2.0 * weights)
+        return np.concatenate([g_mean, g_var], axis=1)
+
+    def test_real_descriptors_real_codebook_match_naive(self):
+        gray = real_image_gray()
+        descs = np.asarray(
+            SIFTExtractor(**TestSiftRealImage.PARAMS)(gray)
+        )[0].T  # [D, 128] descriptors as rows
+        # project to the codebook's 80 dims the way the VOC pipeline does
+        # (VOCSIFTFisher.scala PCA to descDim=80), fitting on this image's own
+        # descriptors since the pipeline's PCA matrix isn't a shipped artifact
+        pca = np.asarray(compute_pca(descs.astype(np.float32), 80))  # [128, 80]
+        x = descs @ pca  # [D, 80]
+        gmm = load_codebook()
+        # subsample for the O(n*k*d) python loop; fixed stride = deterministic
+        sub = x[:: max(1, x.shape[0] // 400)][:400]
+        got = np.asarray(
+            fisher_vector(
+                sub.astype(np.float32), gmm.means, gmm.variances, gmm.weights
+            )
+        )
+        want = self.naive_fv64(
+            sub,
+            np.asarray(gmm.means),
+            np.asarray(gmm.variances),
+            np.asarray(gmm.weights),
+        )
+        assert got.shape == (80, 512)  # [d, 2K], FisherVector.scala:33-34
+        assert np.isfinite(got).all()
+        # f32 vectorized vs f64 loop on real data
+        np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-4)
+
+    def test_full_image_fv_finite_and_nontrivial(self):
+        """Whole-image FV (all ~20k+ real descriptors) against the real
+        codebook is finite and carries signal in most blocks."""
+        gray = real_image_gray()
+        descs = np.asarray(
+            SIFTExtractor(**TestSiftRealImage.PARAMS)(gray)
+        )[0].T
+        pca = np.asarray(compute_pca(descs.astype(np.float32), 80))
+        x = (descs @ pca).astype(np.float32)
+        gmm = load_codebook()
+        fv = np.asarray(
+            fisher_vector(x, gmm.means, gmm.variances, gmm.weights)
+        )
+        assert fv.shape == (80, 512)
+        assert np.isfinite(fv).all()
+        assert float(np.abs(fv).sum()) > 1.0
