@@ -191,6 +191,56 @@ def bench_imagenet_fv_featurize(rng):
     }
 
 
+def bench_decode(rng):
+    """Host ingest: JPEG-tar decode throughput, serial vs thread-pool
+    (reference decodes per-executor in parallel off streamed tars,
+    ImageLoaderUtils.scala:60-100).  The speedup is whatever the bench
+    host's core budget yields — reported, not assumed."""
+    import io
+    import tarfile
+    import tempfile
+
+    from PIL import Image as PILImage
+
+    from keystone_tpu.loaders.image_loaders import (
+        _iter_tar_images,
+        decode_threads,
+    )
+
+    n_images, h, w = 192, 256, 256
+    with tempfile.NamedTemporaryFile(suffix=".tar", delete=False) as tmp:
+        tar_path = tmp.name
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(n_images):
+            arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+            buf = io.BytesIO()
+            PILImage.fromarray(arr).save(buf, format="JPEG", quality=90)
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"img_{i:04d}.jpg")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+    def timed(threads):
+        t0 = time.perf_counter()
+        count = sum(1 for _ in _iter_tar_images(tar_path, num_threads=threads))
+        dt = time.perf_counter() - t0
+        assert count == n_images
+        return n_images / dt
+
+    try:
+        serial = timed(1)
+        threads = decode_threads()
+        threaded = timed(threads)
+    finally:
+        os.unlink(tar_path)
+    return {
+        "decode_threads": threads,
+        "serial_images_per_sec": round(serial, 2),
+        "threaded_images_per_sec": round(threaded, 2),
+        "speedup": round(threaded / serial, 2),
+    }
+
+
 def main():
     rng = np.random.default_rng(0)
     n_chips = len(jax.devices())
@@ -198,6 +248,7 @@ def main():
 
     cifar = bench_cifar_featurize(rng)
     fv = bench_imagenet_fv_featurize(rng)
+    decode = bench_decode(rng)
 
     value = round(cifar["images_per_sec"] / n_chips, 2)
     prior = prior_bench_value("random_patch_cifar_featurize")
@@ -232,7 +283,8 @@ def main():
                         "unit": "images/sec/chip",
                         "mfu": fv_mfu,
                         "flops_per_sec": fv["flops_per_sec"],
-                    }
+                    },
+                    "jpeg_decode": decode,
                 },
             }
         )

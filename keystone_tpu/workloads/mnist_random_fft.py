@@ -161,6 +161,10 @@ def main(argv=None):
     # Labels in the files are 1-indexed (reference :40-42)
     train = LabeledData.from_rows(csv_data_loader(conf.train_location), one_indexed=True)
     test = LabeledData.from_rows(csv_data_loader(conf.test_location), one_indexed=True)
+    # The reference hardcodes mnistImageSize=784 (:24); inferring the width
+    # from the data keeps flag parity while admitting any pixel count
+    # (e.g. the 64-pixel sklearn digits used for real-data accuracy runs).
+    conf.mnist_image_size = train.data.shape[1]
     return run(conf, train, test, mesh=parse_mesh(a.mesh))
 
 

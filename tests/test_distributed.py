@@ -11,6 +11,7 @@ output within about_eq tolerance.
 import jax.numpy as jnp
 import numpy as np
 
+from keystone_tpu.ops.util import VectorSplitter
 from keystone_tpu.parallel.mesh import use_mesh
 from keystone_tpu.solvers.block import BlockLeastSquaresEstimator
 from keystone_tpu.solvers.linear import LinearMapEstimator
@@ -126,3 +127,41 @@ def test_graft_dryrun_impl_in_process(devices):
         graft._dryrun_impl(8)
     finally:
         sys.path.remove(repo_root)
+
+
+def test_multiblock_bcd_model_sharded_matches_monolithic(rng, mesh42):
+    """The 256k-dim analog (VERDICT r2 #9; reference VectorSplitter.scala:10-36
+    + ImageNetSiftLcsFV.scala:186-188): the model dimension deliberately
+    exceeds a per-device column budget, so the fit MUST run as multi-block
+    BCD — and on the 4x2 mesh each block's solve is additionally sharded
+    over the model axis.  Both the blocked structure and the sharding must
+    be semantically invisible: the blocked sharded fit has to agree with
+    the monolithic single-device normal-equations solve."""
+    n, d, k = 600, 96, 5
+    device_column_budget = 16  # d/budget = 6 blocks; budget splits 2-ways
+    a, b = _problem(rng, n=n, d=d, k=k, noise=0.1)
+
+    mono = LinearMapEstimator(lam=0.5).fit(a, b)
+
+    blocks = VectorSplitter(device_column_budget)(a)
+    assert len(blocks) == d // device_column_budget  # genuinely multi-block
+    est = BlockLeastSquaresEstimator(
+        device_column_budget, num_iter=12, lam=0.5, mesh=mesh42
+    )
+    blocked = est.fit(blocks, b)
+
+    # (a) blocked+sharded converges to the monolithic solution: compare
+    # predictions (the model surface the reference equivalence suite uses,
+    # BlockLinearMapperSuite.scala:32-53)
+    pred_mono = np.asarray(mono(a))
+    pred_blocked = np.asarray(blocked(a))
+    scale = np.abs(pred_mono).max()
+    assert np.abs(pred_blocked - pred_mono).max() < 2e-2 * scale
+
+    # (b) the sharded multi-block fit is numerically the LOCAL multi-block
+    # fit (sharding changes nothing but the schedule)
+    local_blocked = BlockLeastSquaresEstimator(
+        device_column_budget, num_iter=12, lam=0.5
+    ).fit(blocks, b)
+    for lm, sm in zip(local_blocked.xs, blocked.xs):
+        assert about_eq(np.asarray(sm), np.asarray(lm), 1e-3)

@@ -68,7 +68,11 @@ def test_timit_mesh_matches_local(rng, mesh8, tmp_path):
     )
     local = timit_run(conf, data)
     sharded = timit_run(conf, data, mesh=mesh8)
-    assert abs(sharded["test_error"] - local["test_error"]) < 1.1
+    # Error is quantized in steps of 100/101 = 0.99pp.  Sharded psum grams
+    # sum in a different f32 order than the single-device fit, which may
+    # flip at most a borderline example: the band admits exactly ONE flip
+    # (two flips = 1.98pp would fail).
+    assert abs(sharded["test_error"] - local["test_error"]) < 1.0
 
 
 def test_cifar_random_patch_mesh_matches_local(rng, mesh8, tmp_path):
@@ -89,7 +93,10 @@ def test_cifar_random_patch_mesh_matches_local(rng, mesh8, tmp_path):
     train, test = cifar_loader(train_path), cifar_loader(test_path)
     local = cifar_run(conf, train, test)
     sharded = cifar_run(conf, train, test, mesh=mesh8)
-    assert abs(sharded["train_error"] - local["train_error"]) < 1.1
+    # One-flip bands (f32 reduction-order drift between sharded psum and
+    # single-device sums can flip at most a borderline example): train error
+    # steps are 100/201 = 0.4975pp, test steps 100/99 = 1.01pp.
+    assert abs(sharded["train_error"] - local["train_error"]) < 0.6
     assert abs(sharded["test_error"] - local["test_error"]) < 1.1
 
 
