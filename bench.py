@@ -6,12 +6,29 @@ reference src/main/scala/pipelines/images/cifar/RandomPatchCifar.scala:53-56
 at the canonical scale (numFilters=100, 6x6 patches, 32x32x3 images) —
 measured as steady-state images/sec/chip on synthetic CIFAR-shaped data.
 
+Timing methodology (round 3 fix): the device here sits behind a tunneled
+transport with ~126 ms host<->device round-trip latency, and repeated
+dispatches of the SAME program on the SAME input are deduplicated somewhere
+in the stack (measured: 40 identical dispatches complete in the time of ~8
+real executions, while a serially-dependent in-graph chain of the same
+computation runs 2.4x slower per step — checksums identical).  Rounds 1-2
+timed dispatch loops and therefore OVERSTATED throughput; all compute
+timings now run as a ``lax.scan`` chain with a serial data dependency
+inside one compiled program (dedup-impossible, transfer-free), with the
+separately-measured round-trip latency subtracted from the single host
+pull.  ``vs_baseline`` against r<=2 records mixes methodologies; the r3
+value is the honest baseline going forward.
+
 Also reported inside the same JSON line:
 - ``mfu`` / ``flops_per_sec``: achieved FLOP/s from XLA's compiled cost
   analysis divided by wall-clock, and the fraction of the chip's peak
   (bf16 systolic-array peak — TPU matmuls run bf16 passes by default).
 - ``solve``: BlockLeastSquares fit time on the featurized batch — the
   reference pipeline's wall-clock is featurize + solve, so both are timed.
+  NOTE: the fit is eager-mode host orchestration (many small dispatches),
+  so on this tunneled transport its wall-clock is dominated by per-dispatch
+  round-trips (~126 ms each), not device compute — a directly-attached
+  host would report a small fraction of this number.
 - ``extra_metrics.imagenet_fv_featurize``: north star #2 — the
   SIFT -> PCA-project -> FisherVector ImageNet featurization branch
   (reference ImageNetSiftLcsFV.scala:41-94) in images/sec/chip.
@@ -56,6 +73,46 @@ PEAK_FLOPS = {
     "TPU v4": 275e12,
     "TPU v6 lite": 918e12,  # v6e / Trillium
 }
+
+
+def roundtrip_latency() -> float:
+    """Host<->device round-trip seconds for a trivial scalar pull."""
+    f = jax.jit(lambda x: x + 1.0)
+    v = float(f(jnp.float32(0)))
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        v = float(f(jnp.float32(v)))
+    return (time.perf_counter() - t0) / reps
+
+
+def timed_chain(fn, arg, chain_len: int, repeats: int = 2) -> float:
+    """Seconds per application of ``fn(arg)``, measured as a lax.scan chain
+    with a serial scalar dependency: iteration i's input is perturbed by
+    iteration i-1's output sum, so no layer of the stack can deduplicate or
+    reorder the executions, and the batch never re-crosses the tunnel.
+    The chain's one host pull is corrected by the measured round-trip."""
+
+    def step(acc, _):
+        out = fn(arg + (acc * 1e-30).astype(arg.dtype))
+        return acc + jnp.sum(out).astype(jnp.float32), None
+
+    @jax.jit
+    def chain(seed):
+        acc, _ = jax.lax.scan(step, seed, None, length=chain_len)
+        return acc
+
+    # distinct seed per dispatch: a repeat is never a bit-identical program
+    # invocation, so the cross-dispatch dedup this function exists to defeat
+    # cannot serve a repeat from cache
+    float(chain(jnp.float32(1.0)))  # compile + warm
+    lat = roundtrip_latency()
+    best = float("inf")
+    for i in range(repeats):
+        t0 = time.perf_counter()
+        float(chain(jnp.float32(2.0 + i)))
+        best = min(best, time.perf_counter() - t0 - lat)
+    return max(best, 1e-9) / chain_len
 
 
 def compiled_flops(jitted_fn, *args) -> float | None:
@@ -107,7 +164,6 @@ def bench_cifar_featurize(rng):
         featurize_chunk=1024,
     )
     n_bench = conf.featurize_chunk
-    iters = 30
 
     train_imgs = rng.uniform(0, 255, (512, 32, 32, 3)).astype(np.float32)
     filters, whitener = learn_filters(conf, train_imgs)
@@ -118,16 +174,12 @@ def bench_cifar_featurize(rng):
         rng.uniform(0, 255, (n_bench, 32, 32, 3)).astype(np.float32)
     )
     feats = feat_fn(batch)
-    feats.block_until_ready()  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = feat_fn(batch)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
+    feats.block_until_ready()  # materialize features for the solve below
 
+    per_iter = timed_chain(conv_pipe.__call__, batch, chain_len=32)
     flops = compiled_flops(feat_fn, batch)
-    images_per_sec = n_bench * iters / dt
-    flops_per_sec = flops * iters / dt if flops else None
+    images_per_sec = n_bench / per_iter
+    flops_per_sec = flops / per_iter if flops else None
 
     # Solve timing: BlockLeastSquares on the featurized batch (reference
     # RandomPatchCifar.scala:68 — the other half of pipeline wall-clock).
@@ -135,14 +187,19 @@ def bench_cifar_featurize(rng):
         2.0 * np.eye(10)[np.random.default_rng(1).integers(0, 10, n_bench)] - 1.0,
         jnp.float32,
     )
+    lat = roundtrip_latency()
     t1 = time.perf_counter()
     model = BlockLeastSquaresEstimator(4096, num_iter=1, lam=10.0).fit(
         feats, labels
     )
-    # fit returns unsynced device arrays; wait for the actual solve, not
-    # just its dispatch, before stopping the clock
-    jax.block_until_ready((model.xs, model.b))
-    solve_secs = time.perf_counter() - t1
+    # fit returns unsynced device arrays; a scalar host pull over EVERY
+    # block is the one sync the tunneled platform honors (block_until_ready
+    # can return before execution on this transport), and the pull's own
+    # round-trip is subtracted like the featurize path does
+    float(
+        sum(jnp.sum(x[0]) for x in model.xs) + jnp.sum(jnp.asarray(model.b))
+    )
+    solve_secs = max(time.perf_counter() - t1 - lat, 1e-9)
 
     return {
         "images_per_sec": images_per_sec,
@@ -157,7 +214,7 @@ def bench_imagenet_fv_featurize(rng):
     """North star #2: the SIFT -> PCA(64) -> FV(16) ImageNet branch
     (reference ImageNetSiftLcsFV.scala:41-94, descDim=64 vocabSize=16) on
     256x256 grayscale images."""
-    n_bench, iters = 64, 10
+    n_bench = 64
     h = w = 256
     desc_dim, vocab = 64, 16
 
@@ -177,17 +234,11 @@ def bench_imagenet_fv_featurize(rng):
 
     fn = jax.jit(featurize)
     batch = jnp.asarray(rng.uniform(0, 1, (n_bench, h, w)).astype(np.float32))
-    fn(batch).block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(batch)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
-
+    per_iter = timed_chain(featurize, batch, chain_len=8)
     flops = compiled_flops(fn, batch)
     return {
-        "images_per_sec": n_bench * iters / dt,
-        "flops_per_sec": flops * iters / dt if flops else None,
+        "images_per_sec": n_bench / per_iter,
+        "flops_per_sec": flops / per_iter if flops else None,
     }
 
 

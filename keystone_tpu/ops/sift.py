@@ -62,6 +62,27 @@ def _triangular_kernel(bin_size: int) -> np.ndarray:
     return t / bin_size  # peak 1, integral bin_size (scale cancels in L2)
 
 
+def _binned_sampling_matrix(
+    length: int, positions: np.ndarray, kernel: np.ndarray
+) -> np.ndarray:
+    """[P, length] matrix S with S @ x == (edge-padded conv of x with
+    ``kernel``) evaluated at ``positions``.
+
+    The spatial binning of dsift is a triangular convolution sampled only at
+    the 4 bin centers per frame — a tiny fraction of the plane.  Expressing
+    "convolve then sample" as one banded matmul turns VPU-bound depthwise
+    convs plus TPU-hostile gathers into MXU gemms (the einsums in
+    ``__call__``); numerics are identical up to f32 summation order."""
+    klen = len(kernel)
+    r = (klen - 1) // 2
+    s = np.zeros((len(positions), length), np.float32)
+    for i, p in enumerate(positions):
+        for t, kv in enumerate(kernel):
+            h = min(max(p + t - r, 0), length - 1)  # edge padding
+            s[i, h] += kv
+    return s
+
+
 def _conv1d_axis(batch, kernel, axis):
     """Convolve [N, H, W] along ``axis`` (1=rows/y, 2=cols/x) with edge pad."""
     k = jnp.asarray(kernel)
@@ -173,16 +194,18 @@ class SIFTExtractor(Transformer):
             gy, gx = _gradients(smoothed)
             planes = _orientation_planes(gy, gx)  # [N, 8, H, W]
             tri = _triangular_kernel(b)
-            conv = _conv1d_axis(
-                _conv1d_axis(planes.reshape(n * NUM_BIN_T, h, w), tri, 1), tri, 2
-            ).reshape(n, NUM_BIN_T, h, w)
 
-            # sample bin centers: frame origin + bin_idx*b
+            # spatial binning as banded matmuls: triangular conv + bin-center
+            # sampling in one MXU gemm per axis (see _binned_sampling_matrix)
             bin_off = np.arange(NUM_BIN_XY) * b
             yy = (ys[:, None] + bin_off[None, :]).ravel()  # [Fy*4]
             xx = (xs[:, None] + bin_off[None, :]).ravel()  # [Fx*4]
+            s_y = jnp.asarray(_binned_sampling_matrix(h, yy, tri))
+            s_x = jnp.asarray(_binned_sampling_matrix(w, xx, tri))
             # [N, 8, Fy*4, Fx*4]
-            sampled = conv[:, :, jnp.asarray(yy), :][:, :, :, jnp.asarray(xx)]
+            sampled = jnp.einsum(
+                "ph,nthw,qw->ntpq", s_y, planes, s_x, optimize=True
+            )
             fy, fx = len(ys), len(xs)
             sampled = sampled.reshape(n, NUM_BIN_T, fy, NUM_BIN_XY, fx, NUM_BIN_XY)
             # descriptor dims ordered [by, bx, t]; frames ordered y-major
