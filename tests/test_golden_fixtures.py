@@ -190,3 +190,60 @@ class TestFisherVectorRealData:
         assert fv.shape == (80, 512)
         assert np.isfinite(fv).all()
         assert float(np.abs(fv).sum()) > 1.0
+
+
+class TestSiftExternalOracle:
+    """External-oracle grounding (SURVEY §2.8): our dense SIFT vs OpenCV's
+    independent SIFT implementation on the real image.
+
+    The reference's own oracle (MATLAB vl_phow dump, VLFeatSuite.scala:41)
+    is absent from its checkout; OpenCV is the available independent
+    implementation.  Conventions differ in known ways: OpenCV weights the
+    descriptor window with a Gaussian (vl_dsift flat window here), and its
+    gradient convention measures angles with y UP (dy = I[y-1]-I[y+1],
+    calcSIFTDescriptor) versus atan2(gy down, gx) here — so orientation
+    bins map through a reflection plus a 1-bin circular shift.  A keypoint
+    of size 2*(binSize*SIFT_DESCR_SCL_FCTR^-1)... concretely size =
+    2*b/3 makes OpenCV's histogram bin width equal our b.  With exactly
+    that predicted mapping (no per-pair search), the two implementations
+    agree strongly on real data — the criterion is cosine similarity, not
+    the reference's +/-1 envelope, because the flat-vs-Gaussian window is a
+    real (documented) difference, not a bug."""
+
+    def test_descriptor_agreement_with_opencv(self):
+        cv2 = pytest.importorskip("cv2")
+        import jax.numpy as jnp
+
+        from keystone_tpu.ops.sift import _scale_geometry
+
+        gray = real_image_gray()[0, :, :, 0]
+        h, w = gray.shape
+        b, step = 4, 3
+        ext = SIFTExtractor(step_size=step, bin_size=b, scales=1, scale_step=0)
+        ours = np.asarray(ext(jnp.asarray(gray[None])))[0]  # [128, D]
+        ys, xs = _scale_geometry(h, w, step, b, 1, 0)
+        centers = [(x + 1.5 * b, y + 1.5 * b) for y in ys for x in xs]
+        idx = np.arange(0, len(centers), 37)[:400]
+
+        kps = [
+            cv2.KeyPoint(float(centers[i][0]), float(centers[i][1]), 2 * b / 3.0, 0.0, 1, 0)
+            for i in idx
+        ]
+        kps_out, desc_cv = cv2.SIFT_create().compute(
+            (gray * 255).astype(np.uint8), kps
+        )
+        assert desc_cv is not None and len(kps_out) == len(idx)
+
+        a = ours[:, idx].T.astype(np.float64)
+        bm = desc_cv.astype(np.float64)
+        an = a / np.maximum(np.linalg.norm(a, axis=1, keepdims=True), 1e-9)
+        bn = bm / np.maximum(np.linalg.norm(bm, axis=1, keepdims=True), 1e-9)
+        # fixed convention mapping: reflect orientation axis, shift 1
+        mapped = np.roll(an.reshape(-1, 4, 4, 8)[..., ::-1], 1, axis=3).reshape(-1, 128)
+        cos = np.sum(mapped * bn, axis=1)
+        assert np.median(cos) > 0.85, np.median(cos)
+        assert np.mean(cos) > 0.80, np.mean(cos)
+        # sanity: the agreement is specific to the derived mapping — a wrong
+        # orientation shift must score clearly worse
+        wrong = np.roll(an.reshape(-1, 4, 4, 8)[..., ::-1], 5, axis=3).reshape(-1, 128)
+        assert np.median(np.sum(wrong * bn, axis=1)) < np.median(cos) - 0.1
