@@ -13,11 +13,12 @@ in the stack (measured: 40 identical dispatches complete in the time of ~8
 real executions, while a serially-dependent in-graph chain of the same
 computation runs 2.4x slower per step — checksums identical).  Rounds 1-2
 timed dispatch loops and therefore OVERSTATED throughput; all compute
-timings now run as a ``lax.scan`` chain with a serial data dependency
-inside one compiled program (dedup-impossible, transfer-free), with the
-separately-measured round-trip latency subtracted from the single host
-pull.  ``vs_baseline`` against r<=2 records mixes methodologies; the r3
-value is the honest baseline going forward.
+timings now run as a ``lax.scan`` chain with a serial data dependency and a
+non-linear readout inside one compiled program (dedup-impossible,
+transfer-free), and fixed costs cancel by differencing a K-length and a
+2K-length chain (see timed_chain).  ``vs_baseline`` against r<=2 records
+mixes methodologies; the r3 value is the honest baseline going forward.
+Residual run-to-run spread on this shared tunneled chip is ~10-15%.
 
 Also reported inside the same JSON line:
 - ``mfu`` / ``flops_per_sec``: achieved FLOP/s from XLA's compiled cost
@@ -89,30 +90,53 @@ def roundtrip_latency() -> float:
 def timed_chain(fn, arg, chain_len: int, repeats: int = 2) -> float:
     """Seconds per application of ``fn(arg)``, measured as a lax.scan chain
     with a serial scalar dependency: iteration i's input is perturbed by
-    iteration i-1's output sum, so no layer of the stack can deduplicate or
-    reorder the executions, and the batch never re-crosses the tunnel.
-    The chain's one host pull is corrected by the measured round-trip."""
+    iteration i-1's sum-of-squares readout, so no layer of the stack can
+    deduplicate or reorder the executions, the readout is non-linear (see
+    the comment in ``step``), and the batch never re-crosses the tunnel.
 
-    def step(acc, _):
-        out = fn(arg + (acc * 1e-30).astype(arg.dtype))
-        return acc + jnp.sum(out).astype(jnp.float32), None
+    Fixed costs (the ~126 ms round-trip, dispatch, the host pull) are
+    cancelled by DIFFERENCING chains of length ``chain_len`` and
+    ``2*chain_len`` rather than subtracting a separately-measured latency —
+    the latency estimate's own +/-30 ms jitter otherwise dominates when the
+    chain's compute is tens of milliseconds."""
 
-    @jax.jit
-    def chain(seed):
-        acc, _ = jax.lax.scan(step, seed, None, length=chain_len)
-        return acc
+    def step(a, acc, _):
+        out = fn(a + (acc * 1e-30).astype(a.dtype))
+        # sum-of-SQUARES readout: a plain sum is linear, and XLA's algebraic
+        # simplifier can collapse sum∘conv / sum∘pool into closed forms that
+        # skip the very work being timed (observed: a lone conv "measured"
+        # 2x above peak FLOP/s under a linear readout)
+        return acc + jnp.sum(out * out).astype(jnp.float32), None
+
+    # ``arg`` enters as a runtime parameter, NOT a closure: closed-over
+    # arrays are embedded in the lowered program, which blows up remote
+    # compile payloads for large operands
+    def make_chain(length):
+        @jax.jit
+        def chain(seed, a):
+            acc, _ = jax.lax.scan(
+                lambda c, x: step(a, c, x), seed, None, length=length
+            )
+            return acc
+
+        return chain
+
+    short, long = make_chain(chain_len), make_chain(2 * chain_len)
 
     # distinct seed per dispatch: a repeat is never a bit-identical program
     # invocation, so the cross-dispatch dedup this function exists to defeat
     # cannot serve a repeat from cache
-    float(chain(jnp.float32(1.0)))  # compile + warm
-    lat = roundtrip_latency()
-    best = float("inf")
+    float(short(jnp.float32(1.0), arg))  # compile + warm
+    float(long(jnp.float32(1.5), arg))
+    best_short = best_long = float("inf")
     for i in range(repeats):
         t0 = time.perf_counter()
-        float(chain(jnp.float32(2.0 + i)))
-        best = min(best, time.perf_counter() - t0 - lat)
-    return max(best, 1e-9) / chain_len
+        float(short(jnp.float32(2.0 + i), arg))
+        best_short = min(best_short, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        float(long(jnp.float32(20.0 + i), arg))
+        best_long = min(best_long, time.perf_counter() - t0)
+    return max(best_long - best_short, 1e-9) / chain_len
 
 
 def compiled_flops(jitted_fn, *args) -> float | None:
@@ -176,7 +200,7 @@ def bench_cifar_featurize(rng):
     feats = feat_fn(batch)
     feats.block_until_ready()  # materialize features for the solve below
 
-    per_iter = timed_chain(conv_pipe.__call__, batch, chain_len=32)
+    per_iter = timed_chain(conv_pipe.__call__, batch, chain_len=64)
     flops = compiled_flops(feat_fn, batch)
     images_per_sec = n_bench / per_iter
     flops_per_sec = flops / per_iter if flops else None
@@ -234,7 +258,7 @@ def bench_imagenet_fv_featurize(rng):
 
     fn = jax.jit(featurize)
     batch = jnp.asarray(rng.uniform(0, 1, (n_bench, h, w)).astype(np.float32))
-    per_iter = timed_chain(featurize, batch, chain_len=8)
+    per_iter = timed_chain(featurize, batch, chain_len=12)
     flops = compiled_flops(fn, batch)
     return {
         "images_per_sec": n_bench / per_iter,
