@@ -62,7 +62,21 @@ class LabeledImages:
 def decode_image(data: bytes) -> np.ndarray | None:
     """JPEG/PNG bytes -> f32[H, W, 3] BGR in [0, 255]; None when rejected
     (the reference logs and skips undecodable/small/odd-channel images,
-    ImageLoaderUtils.scala:78-96)."""
+    ImageLoaderUtils.scala:78-96).
+
+    JPEG streams decode through the native C++ libjpeg binding
+    (native/ingest.cpp via loaders/native_decode.py — bit-identical output,
+    no Python image library on the hot path); PNG and anything the native
+    decoder declines falls back to PIL."""
+    if data[:2] == b"\xff\xd8":
+        from .native_decode import decode_jpeg_native
+
+        arr = decode_jpeg_native(data)
+        if arr is not None:
+            return arr
+        # fall through: native unavailable, stream corrupt, or image
+        # rejected — the PIL path reproduces the same accept/reject rules
+
     from PIL import Image as PILImage
 
     try:

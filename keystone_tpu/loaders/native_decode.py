@@ -1,0 +1,94 @@
+"""ctypes binding for the native C++ JPEG decoder (native/ingest.cpp).
+
+The shared library is built lazily with the system toolchain on first use
+(g++ + libjpeg, both baked into the image) and cached next to the source.
+ctypes releases the GIL for the duration of each decode call, so the
+thread-pool loader in image_loaders.py parallelizes across host cores with
+no Python image library on the hot path.  ``KEYSTONE_NATIVE_DECODE=0``
+disables the native path; anything unbuildable or undecodable falls back
+to PIL transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_SRC = os.path.join(_NATIVE_DIR, "ingest.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libkstingest.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", _SRC, "-o", _LIB, "-ljpeg",
+    ]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return res.returncode == 0 and os.path.exists(_LIB)
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("KEYSTONE_NATIVE_DECODE", "").strip() == "0":
+            return None
+        try:
+            if not os.path.exists(_LIB) or os.path.getmtime(
+                _LIB
+            ) < os.path.getmtime(_SRC):
+                if not _build():
+                    return None
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.kst_decode_jpeg.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.kst_decode_jpeg.restype = ctypes.c_int
+        lib.kst_free.argtypes = [ctypes.POINTER(ctypes.c_float)]
+        lib.kst_free.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_jpeg_native(data: bytes) -> np.ndarray | None:
+    """JPEG bytes -> f32[H, W, 3] BGR in [0, 255], or None when the stream
+    is corrupt, rejected (<36 px), or the native library is unavailable.
+    Matches image_loaders.decode_image semantics bit-for-... well, within
+    libjpeg-version IDCT differences of PIL (see tests)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_float)()
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    rc = lib.kst_decode_jpeg(data, len(data), ctypes.byref(out), ctypes.byref(h), ctypes.byref(w))
+    if rc != 0:
+        return None
+    try:
+        arr = np.ctypeslib.as_array(out, shape=(h.value, w.value, 3)).copy()
+    finally:
+        lib.kst_free(out)
+    return arr
