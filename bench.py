@@ -26,10 +26,10 @@ Also reported inside the same JSON line:
   (bf16 systolic-array peak — TPU matmuls run bf16 passes by default).
 - ``solve``: BlockLeastSquares fit time on the featurized batch — the
   reference pipeline's wall-clock is featurize + solve, so both are timed.
-  NOTE: the fit is eager-mode host orchestration (many small dispatches),
-  so on this tunneled transport its wall-clock is dominated by per-dispatch
-  round-trips (~126 ms each), not device compute — a directly-attached
-  host would report a small fraction of this number.
+  The fit is ONE compiled program (solvers/block._fused_bcd_fit);
+  ``solve_seconds`` is steady-state wall-clock (one dispatch round-trip on
+  this tunneled transport), ``solve_device_seconds`` is chain-measured
+  device compute only.
 - ``extra_metrics.imagenet_fv_featurize``: north star #2 — the
   SIFT -> PCA-project -> FisherVector ImageNet featurization branch
   (reference ImageNetSiftLcsFV.scala:41-94) in images/sec/chip.
@@ -75,6 +75,17 @@ PEAK_FLOPS = {
     "TPU v6 lite": 918e12,  # v6e / Trillium
 }
 
+# HBM bandwidth per chip (public specs) — the roofline denominator.  An op
+# with arithmetic intensity I FLOP/byte is memory-bound below the ridge
+# point (peak_flops / hbm_bw) and its ceiling is I * hbm_bw.
+HBM_BW = {
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v4": 1228e9,
+    "TPU v6 lite": 1640e9,
+}
+
 
 def roundtrip_latency() -> float:
     """Host<->device round-trip seconds for a trivial scalar pull."""
@@ -87,7 +98,7 @@ def roundtrip_latency() -> float:
     return (time.perf_counter() - t0) / reps
 
 
-def timed_chain(fn, arg, chain_len: int, repeats: int = 2) -> float:
+def timed_chain(fn, arg, chain_len: int, repeats: int = 3) -> float:
     """Seconds per application of ``fn(arg)``, measured as a lax.scan chain
     with a serial scalar dependency: iteration i's input is perturbed by
     iteration i-1's sum-of-squares readout, so no layer of the stack can
@@ -136,11 +147,24 @@ def timed_chain(fn, arg, chain_len: int, repeats: int = 2) -> float:
         t0 = time.perf_counter()
         float(long(jnp.float32(20.0 + i), arg))
         best_long = min(best_long, time.perf_counter() - t0)
-    return max(best_long - best_short, 1e-9) / chain_len
+    diff = best_long - best_short
+    # The differenced mins must clear the transport's jitter floor — when the
+    # chain's own compute is comparable to the ~±30 ms dispatch noise the
+    # difference can go near-zero (or negative) and a silent clamp would
+    # report absurdly inflated throughput.  Fail loudly instead: the caller
+    # should raise chain_len until the chain compute dominates the noise.
+    if diff < 0.1 * best_short:
+        raise RuntimeError(
+            f"timed_chain noise floor: best_long-best_short={diff:.4f}s is "
+            f"<10% of best_short={best_short:.4f}s; raise chain_len "
+            f"(chain compute does not dominate transport jitter)"
+        )
+    return diff / chain_len
 
 
-def compiled_flops(jitted_fn, *args) -> float | None:
-    """Total FLOPs of the compiled program from XLA's cost analysis.
+def compiled_cost(jitted_fn, *args) -> tuple[float | None, float | None]:
+    """(FLOPs, HBM bytes accessed) of the compiled program from XLA's cost
+    analysis — the roofline numerator and denominator.
 
     Takes the already-jitted wrapper so lowering hits the jit cache instead
     of tracing and compiling the program a second time."""
@@ -148,9 +172,28 @@ def compiled_flops(jitted_fn, *args) -> float | None:
         analysis = jitted_fn.lower(*args).compile().cost_analysis()
         if isinstance(analysis, (list, tuple)):
             analysis = analysis[0]
-        return float(analysis.get("flops", 0.0)) or None
+        return (
+            float(analysis.get("flops", 0.0)) or None,
+            float(analysis.get("bytes accessed", 0.0)) or None,
+        )
     except Exception:
-        return None
+        return None, None
+
+
+def roofline(flops, bytes_accessed, per_iter, peak, bw):
+    """Arithmetic intensity, memory-bound ceiling, and achieved fractions."""
+    if not (flops and bytes_accessed and peak and bw):
+        return {}
+    intensity = flops / bytes_accessed
+    ceiling = min(intensity * bw, peak)
+    achieved = flops / per_iter
+    return {
+        "intensity_flop_per_byte": round(intensity, 2),
+        "ridge_flop_per_byte": round(peak / bw, 1),
+        "memory_ceiling_flops": ceiling,
+        "fraction_of_ceiling": round(achieved / ceiling, 3),
+        "hbm_gbps_achieved": round(bytes_accessed / per_iter / 1e9, 1),
+    }
 
 
 def prior_bench_value(metric: str) -> float | None:
@@ -200,37 +243,60 @@ def bench_cifar_featurize(rng):
     feats = feat_fn(batch)
     feats.block_until_ready()  # materialize features for the solve below
 
-    per_iter = timed_chain(conv_pipe.__call__, batch, chain_len=64)
-    flops = compiled_flops(feat_fn, batch)
+    per_iter = timed_chain(conv_pipe.__call__, batch, chain_len=128)
+    flops, bytes_accessed = compiled_cost(feat_fn, batch)
     images_per_sec = n_bench / per_iter
     flops_per_sec = flops / per_iter if flops else None
 
     # Solve timing: BlockLeastSquares on the featurized batch (reference
     # RandomPatchCifar.scala:68 — the other half of pipeline wall-clock).
+    # The fit is ONE compiled program (solvers/block._fused_bcd_fit); the
+    # first call is the compile warm-up, the second is the steady-state
+    # wall-clock (dispatch + compute + one scalar pull, minus the measured
+    # round-trip), and the chain measurement is device compute only.
     labels = jnp.asarray(
         2.0 * np.eye(10)[np.random.default_rng(1).integers(0, 10, n_bench)] - 1.0,
         jnp.float32,
     )
+    est = BlockLeastSquaresEstimator(4096, num_iter=1, lam=10.0)
+
+    def pull(model):
+        # fit returns unsynced device arrays; a scalar host pull is the one
+        # sync the tunneled platform honors (block_until_ready can return
+        # before execution on this transport)
+        float(
+            sum(jnp.sum(x[0]) for x in model.xs) + jnp.sum(jnp.asarray(model.b))
+        )
+
+    pull(est.fit(feats, labels))  # compile warm-up
     lat = roundtrip_latency()
     t1 = time.perf_counter()
-    model = BlockLeastSquaresEstimator(4096, num_iter=1, lam=10.0).fit(
-        feats, labels
-    )
-    # fit returns unsynced device arrays; a scalar host pull over EVERY
-    # block is the one sync the tunneled platform honors (block_until_ready
-    # can return before execution on this transport), and the pull's own
-    # round-trip is subtracted like the featurize path does
-    float(
-        sum(jnp.sum(x[0]) for x in model.xs) + jnp.sum(jnp.asarray(model.b))
-    )
+    pull(est.fit(feats, labels))
     solve_secs = max(time.perf_counter() - t1 - lat, 1e-9)
+
+    # Device-compute-only: the same fused fit program in a serial chain.
+    from keystone_tpu.solvers.block import _fused_bcd_fit
+
+    def solve_fn(f):
+        models, _, _ = _fused_bcd_fit(
+            (f,), labels, jnp.float32(est.lam), f.shape[0], est.num_iter,
+            (f.shape[1],), None,
+        )
+        return models[0]
+
+    solve_device_secs = timed_chain(solve_fn, feats, chain_len=256)
 
     return {
         "images_per_sec": images_per_sec,
         "flops_per_sec": flops_per_sec,
         "flops_per_image": flops / n_bench if flops else None,
+        "bytes_per_image": bytes_accessed / n_bench if bytes_accessed else None,
+        "per_iter": per_iter,
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
         "solve_seconds": solve_secs,
         "solve_examples_per_sec": n_bench / solve_secs,
+        "solve_device_seconds": solve_device_secs,
     }
 
 
@@ -259,10 +325,13 @@ def bench_imagenet_fv_featurize(rng):
     fn = jax.jit(featurize)
     batch = jnp.asarray(rng.uniform(0, 1, (n_bench, h, w)).astype(np.float32))
     per_iter = timed_chain(featurize, batch, chain_len=12)
-    flops = compiled_flops(fn, batch)
+    flops, bytes_accessed = compiled_cost(fn, batch)
     return {
         "images_per_sec": n_bench / per_iter,
         "flops_per_sec": flops / per_iter if flops else None,
+        "per_iter": per_iter,
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
     }
 
 
@@ -319,7 +388,9 @@ def bench_decode(rng):
 def main():
     rng = np.random.default_rng(0)
     n_chips = len(jax.devices())
-    peak = PEAK_FLOPS.get(jax.devices()[0].device_kind)
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(kind)
+    bw = HBM_BW.get(kind)
 
     cifar = bench_cifar_featurize(rng)
     fv = bench_imagenet_fv_featurize(rng)
@@ -347,17 +418,31 @@ def main():
                 "mfu": mfu,
                 "flops_per_sec": cifar["flops_per_sec"],
                 "flops_per_image": cifar["flops_per_image"],
+                "bytes_per_image": cifar["bytes_per_image"],
+                "roofline": roofline(
+                    cifar["flops"], cifar["bytes_accessed"],
+                    cifar["per_iter"],
+                    peak * n_chips if peak else None,
+                    bw * n_chips if bw else None,
+                ),
                 "peak_flops_per_chip": peak,
                 "solve_seconds": round(cifar["solve_seconds"], 4),
                 "solve_examples_per_sec": round(
                     cifar["solve_examples_per_sec"], 2
                 ),
+                "solve_device_seconds": round(cifar["solve_device_seconds"], 6),
                 "extra_metrics": {
                     "imagenet_fv_featurize": {
                         "value": round(fv["images_per_sec"] / n_chips, 2),
                         "unit": "images/sec/chip",
                         "mfu": fv_mfu,
                         "flops_per_sec": fv["flops_per_sec"],
+                        "roofline": roofline(
+                            fv["flops"], fv["bytes_accessed"],
+                            fv["per_iter"],
+                            peak * n_chips if peak else None,
+                            bw * n_chips if bw else None,
+                        ),
                     },
                     "jpeg_decode": decode,
                 },

@@ -65,9 +65,10 @@ def decode_image(data: bytes) -> np.ndarray | None:
     ImageLoaderUtils.scala:78-96).
 
     JPEG streams decode through the native C++ libjpeg binding
-    (native/ingest.cpp via loaders/native_decode.py — bit-identical output,
-    no Python image library on the hot path); PNG and anything the native
-    decoder declines falls back to PIL."""
+    (native/ingest.cpp via loaders/native_decode.py — identical to PIL up
+    to libjpeg IDCT version differences, no Python image library on the
+    hot path); PNG and anything the native decoder declines falls back to
+    PIL."""
     if data[:2] == b"\xff\xd8":
         from .native_decode import decode_jpeg_native
 
@@ -148,6 +149,12 @@ def _iter_tar_images(path: str, num_threads: int | None = None):
     double-buffering without unbounded memory.
     """
     num_threads = num_threads or decode_threads()
+    # Build/load the native decoder BEFORE the pool spins up: the one-time
+    # g++ build runs under native_decode's module lock, and paying it lazily
+    # inside the first decode call would stall every worker behind it.
+    from .native_decode import available as _native_available
+
+    _native_available()
     if num_threads <= 1:
         for name, data in _iter_tar_members(path):
             img = decode_image(data)

@@ -12,11 +12,14 @@ to PIL transparently.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
 
 import numpy as np
+
+_logger = logging.getLogger(__name__)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _SRC = os.path.join(_NATIVE_DIR, "ingest.cpp")
@@ -39,6 +42,13 @@ def _build() -> bool:
 
 
 def _load() -> ctypes.CDLL | None:
+    """Build (first use only) + dlopen the native decoder.
+
+    Call this (via :func:`available`) BEFORE entering a decode hot path:
+    the one-time g++ build runs under the module lock, so a lazy first call
+    from inside a thread-pool loader would stall every worker behind it.
+    The loaders do so (image_loaders._iter_tar_images); fallback to PIL is
+    logged once so a silent slow path is attributable."""
     global _lib, _tried
     with _lock:
         if _tried:
@@ -51,9 +61,15 @@ def _load() -> ctypes.CDLL | None:
                 _LIB
             ) < os.path.getmtime(_SRC):
                 if not _build():
+                    _logger.warning(
+                        "native JPEG decoder build failed; falling back to PIL"
+                    )
                     return None
             lib = ctypes.CDLL(_LIB)
         except OSError:
+            _logger.warning(
+                "native JPEG decoder unavailable; falling back to PIL"
+            )
             return None
         lib.kst_decode_jpeg.argtypes = [
             ctypes.c_char_p,

@@ -60,8 +60,12 @@ int kst_decode_jpeg(const unsigned char* data, long len, float** out,
   trap.mgr.error_exit = error_exit_trap;
   trap.mgr.output_message = silence_output;
 
-  float* pixels = nullptr;
-  unsigned char* row = nullptr;
+  // volatile: modified between setjmp and longjmp — without it their
+  // post-longjmp values are indeterminate (C++ [support.runtime]), so the
+  // corrupt-stream error path could leak or free garbage (libjpeg
+  // example.c uses the same pattern).
+  float* volatile pixels = nullptr;
+  unsigned char* volatile row = nullptr;
   if (setjmp(trap.jump)) {
     jpeg_destroy_decompress(&cinfo);
     std::free(pixels);

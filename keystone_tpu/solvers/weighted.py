@@ -139,6 +139,49 @@ def _class_solves(
     return dws.reshape(n_chunks * chunk, d)[:c_total].T  # [d, C]
 
 
+@functools.partial(
+    jax.jit, static_argnames=("n_max", "chunk", "num_classes", "mesh")
+)
+def _bwls_block_pass(
+    xb_pad, res_pad, seg_ids, starts, counts, counts_f,
+    pop_cov, pop_mean, joint_means, residual_mean, model,
+    nvalid, lam, w,
+    n_max: int, chunk: int, num_classes: int, mesh=None,
+):
+    """One FUSED block update of a BWLS pass: population XᵀR gram, the
+    class-solve sweep, the model update, the residual update and the new
+    residual class means as a single compiled program — round 3 ran these
+    as ~5 eager dispatches per block per pass over a ~126 ms-round-trip
+    transport.  (reference :228-311: one statistics job + one solve +
+    residual update per block per pass)."""
+    n = nvalid.astype(xb_pad.dtype)
+    pop_xtr = xb_pad.T @ res_pad / n
+    dw = _class_solves(
+        xb_pad, res_pad, starts, counts, pop_cov, pop_mean, pop_xtr,
+        joint_means, residual_mean, model, lam, w, n_max, chunk, mesh,
+    )
+    model_new = model + dw
+    res_new = res_pad - xb_pad @ dw
+    residual_mean_new = _residual_class_means(
+        res_new, seg_ids, counts_f, num_classes
+    )
+    return model_new, res_new, residual_mean_new
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes",))
+def _bwls_block_stats(xb_pad, seg_ids, counts_f, nvalid, w, num_classes: int):
+    """Per-block population statistics, fused into one program (the
+    reference's per-block treeReduce job, :134-160): population mean,
+    covariance, and the mixture joint means."""
+    n = nvalid.astype(xb_pad.dtype)
+    pop_mean = jnp.sum(xb_pad, axis=0) / n
+    ata = xb_pad.T @ xb_pad
+    pop_cov = ata / n - jnp.outer(pop_mean, pop_mean)
+    class_means = _class_sums(xb_pad, seg_ids, num_classes) / counts_f[:, None]
+    joint_means = w * class_means + (1.0 - w) * pop_mean
+    return pop_cov, pop_mean, joint_means
+
+
 @functools.partial(jax.jit, static_argnames=("num_classes",))
 def _class_sums(x_pad, seg_ids, num_classes: int):
     """Per-class row sums of a (sorted, padded) block via segment sum.
@@ -330,42 +373,23 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         lam_arr = jnp.asarray(self.lam, dtype)
         w_arr = jnp.asarray(w, dtype)
 
+        nvalid_arr = jnp.asarray(n)
         for _pass in range(self.num_iter):
             for bi, xb_pad in enumerate(blocks_padded):
                 if block_stats[bi] is None:
-                    pop_mean = jnp.sum(xb_pad, axis=0) / n
-                    ata = xb_pad.T @ xb_pad
-                    pop_cov = ata / n - jnp.outer(pop_mean, pop_mean)
-                    class_means = (
-                        _class_sums(xb_pad, seg_ids, n_classes)
-                        / counts_f[:, None]
+                    # one fused statistics program per block (cached
+                    # across passes, like the reference's persisted grams)
+                    block_stats[bi] = _bwls_block_stats(
+                        xb_pad, seg_ids, counts_f, nvalid_arr, w_arr, n_classes
                     )
-                    joint_means = w * class_means + (1.0 - w) * pop_mean
-                    block_stats[bi] = (pop_cov, pop_mean, joint_means)
-                else:
-                    pop_cov, pop_mean, joint_means = block_stats[bi]
-                pop_xtr = xb_pad.T @ res_pad / n
-                dw = _class_solves(
-                    xb_pad,
-                    res_pad,
-                    starts,
-                    counts,
-                    pop_cov,
-                    pop_mean,
-                    pop_xtr,
-                    joint_means,
-                    residual_mean,
-                    models[bi],
-                    lam_arr,
-                    w_arr,
-                    n_max,
-                    chunk,
-                    mesh,
-                )
-                models[bi] = models[bi] + dw
-                res_pad = res_pad - xb_pad @ dw
-                residual_mean = _residual_class_means(
-                    res_pad, seg_ids, counts_f, n_classes
+                pop_cov, pop_mean, joint_means = block_stats[bi]
+                # one fused program per block per pass: XᵀR + class solves
+                # + model/residual updates + residual class means
+                models[bi], res_pad, residual_mean = _bwls_block_pass(
+                    xb_pad, res_pad, seg_ids, starts, counts, counts_f,
+                    pop_cov, pop_mean, joint_means, residual_mean,
+                    models[bi], nvalid_arr, lam_arr, w_arr,
+                    n_max, chunk, n_classes, mesh,
                 )
 
         # Intercept from joint means (reference :307-311):

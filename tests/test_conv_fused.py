@@ -1,0 +1,90 @@
+"""FusedConvFeaturizer vs the op-by-op chain (the fused path is the cifar
+workload default; equivalence here is what licenses that swap — reference
+chain RandomPatchCifar.scala:53-56, ConvolverSuite/PoolingSuite spirit)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.conv_fused import FusedConvFeaturizer
+from keystone_tpu.ops.images import (
+    Convolver,
+    ImageVectorizer,
+    Pooler,
+    SymmetricRectifier,
+)
+from keystone_tpu.core.pipeline import Pipeline
+
+
+def _unfused(filters, means, alpha, stride, size):
+    return Pipeline(
+        [
+            Convolver(filters, whitener_means=means, normalize_patches=True,
+                      img_channels=filters.shape[-1]),
+            SymmetricRectifier(alpha=alpha),
+            Pooler(stride, size, None, "sum"),
+            ImageVectorizer(),
+        ]
+    )
+
+
+@pytest.mark.parametrize(
+    "h,w,fsz,ws,stride,size",
+    [
+        (32, 32, 100, 6, 13, 14),  # the RandomPatchCifar shape
+        (20, 24, 7, 5, 4, 6),      # uneven dims, truncated edge pools
+        (16, 16, 3, 3, 5, 5),      # odd pool size (span ps-1 semantics)
+    ],
+)
+def test_fused_matches_unfused_f32(rng, h, w, fsz, ws, stride, size):
+    imgs = jnp.asarray(rng.uniform(0, 255, (5, h, w, 3)).astype(np.float32))
+    filters = jnp.asarray(rng.normal(size=(fsz, ws, ws, 3)).astype(np.float32))
+    means = jnp.asarray(rng.normal(size=(ws * ws * 3,)).astype(np.float32))
+    ref = np.asarray(_unfused(filters, means, 0.25, stride, size)(imgs))
+    got = np.asarray(
+        FusedConvFeaturizer(
+            filters, whitener_means=means, pool_stride=stride, pool_size=size,
+            alpha=0.25, activation_dtype=jnp.float32,
+        )(imgs)
+    )
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5 * np.abs(ref).max())
+
+
+def test_fused_bf16_within_storage_rounding(rng):
+    imgs = jnp.asarray(rng.uniform(0, 255, (4, 32, 32, 3)).astype(np.float32))
+    filters = jnp.asarray(rng.normal(size=(24, 6, 6, 3)).astype(np.float32))
+    means = jnp.asarray(rng.normal(size=(108,)).astype(np.float32))
+    ref = np.asarray(_unfused(filters, means, 0.25, 13, 14)(imgs))
+    got = np.asarray(
+        FusedConvFeaturizer(
+            filters, whitener_means=means, pool_stride=13, pool_size=14,
+            alpha=0.25,  # default bf16 activations
+        )(imgs)
+    )
+    # bf16 storage rounds each activation once (~2^-8 relative); pooled sums
+    # of 196 activations stay within ~1% of the f32 chain.
+    err = np.abs(got - ref).max() / np.abs(ref).max()
+    assert err < 1e-2, err
+
+
+def test_fused_no_normalization_no_means(rng):
+    imgs = jnp.asarray(rng.uniform(0, 1, (3, 16, 16, 2)).astype(np.float32))
+    filters = jnp.asarray(rng.normal(size=(5, 4, 4, 2)).astype(np.float32))
+    ref = np.asarray(
+        Pipeline(
+            [
+                Convolver(filters, normalize_patches=False, img_channels=2),
+                SymmetricRectifier(alpha=0.1),
+                Pooler(4, 4, None, "sum"),
+                ImageVectorizer(),
+            ]
+        )(imgs)
+    )
+    got = np.asarray(
+        FusedConvFeaturizer(
+            filters, pool_stride=4, pool_size=4, alpha=0.1,
+            normalize_patches=False, activation_dtype=jnp.float32,
+        )(imgs)
+    )
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5 * np.abs(ref).max())
