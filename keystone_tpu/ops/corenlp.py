@@ -30,8 +30,12 @@ from typing import Sequence
 
 from ..core.pipeline import Transformer
 
-_SENT_SPLIT = re.compile(r"[.!?]+")
-_TOKEN = re.compile(r"[A-Za-z0-9']+")
+# Terminal punctuation only at a whitespace/end boundary — "3.14" is one
+# token, not a sentence break.
+_SENT_SPLIT = re.compile(r"[.!?]+(?=\s|$)")
+# Numbers keep internal , and . ("4,200", "3.14"); word tokens start with a
+# letter (a bare "'''" must not become an empty token after normalization).
+_TOKEN = re.compile(r"[0-9][0-9.,]*|[A-Za-z][A-Za-z0-9']*")
 _NON_ALNUM = re.compile(r"[^a-zA-Z0-9\s+]")
 _NUMERIC = re.compile(r"^[0-9][0-9,.]*$")
 
@@ -117,9 +121,9 @@ def lemmatize(word: str) -> str:
 
 def _needs_e(stem: str) -> bool:
     """Heuristic: restore silent e after stripping -ing/-ed for stems like
-    mak-, writ-, driv-, tak- (single vowel + single final consonant that
-    commonly ends an e-final base)."""
-    return stem[-1] in set("kvztcgu") or stem.endswith(("at", "it", "ot", "ut"))
+    mak-, writ-, driv-, tak-, encod- (single vowel + single final consonant
+    that commonly ends an e-final base)."""
+    return stem[-1] in set("kvztcgud") or stem.endswith(("at", "it", "ot", "ut"))
 
 
 # Compact gazetteers — the reference resolves these through CoreNLP's models.

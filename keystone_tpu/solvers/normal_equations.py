@@ -133,6 +133,12 @@ def bcd_least_squares_l2(
     re-owns ml-matrix ``BlockCoordinateDescent.solveLeastSquaresWithL2``
     (SURVEY §2.2, called at reference BlockLinearMapper.scala:196-198).
 
+    NOTE: the production fit path is ``solvers.block._fused_bcd_fit`` (one
+    compiled program per fit).  This step-at-a-time form is kept as the
+    REFERENCE ORACLE the fused path is tested against
+    (tests/test_solvers.py::test_fused_fit_matches_stepwise_oracle) and as
+    the BCD entry point for callers holding pre-centered blocks.
+
     Per epoch, per block i:  solve
     ``(A_iᵀA_i + λI) X_i' = A_iᵀ (R + A_i X_i)`` where ``R = B - Σ_j A_j X_j``
     is the running residual, then update R.  Block grams are computed once and

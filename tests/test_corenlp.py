@@ -70,3 +70,83 @@ class TestNGrams:
     def test_batch_form(self):
         out = CoreNLPFeatureExtractor([1])(["a b", "c"])
         assert out == [["a", "b"], ["c"]]
+
+
+class TestOpenVocabulary:
+    """Property tests on inputs the implementation never hard-coded — the
+    gazetteer/lemma tables must not be the only thing the tests exercise
+    (reference CoreNLPFeatureExtractor.scala:18-45 handles open vocabulary
+    through CoreNLP's models; the stand-in's rules must generalize)."""
+
+    def test_unseen_regular_inflections(self):
+        # None of these appear in _IRREGULAR/_NO_STRIP; the suffix rules
+        # alone must produce the lemma.
+        cases = {
+            "computers": "computer",
+            "testing": "test",
+            "walked": "walk",
+            "dropped": "drop",      # consonant un-doubling
+            "flipping": "flip",
+            "baking": "bake",       # silent-e restoration
+            "encoded": "encode",
+            "compilers": "compiler",
+            "benchmarks": "benchmark",
+            "churches": "church",   # -ches
+            "boxes": "box",         # -xes
+            "berries": "berry",     # -ies -> y
+        }
+        for word, lemma in cases.items():
+            assert lemmatize(word) == lemma, (word, lemmatize(word))
+
+    def test_lemmatize_idempotent_on_unseen_words(self):
+        # Applying the lemmatizer to its own output must be a fixed point —
+        # a second strip would mangle open-vocabulary stems.
+        words = [
+            "tokenizers", "sharding", "pipelined", "gemms", "reshaped",
+            "collectives", "meshes", "latencies", "fusing", "benchmarked",
+            "quantum", "syzygy", "keystone", "tpu", "xla",
+        ]
+        for w in words:
+            once = lemmatize(w)
+            assert lemmatize(once) == once, (w, once, lemmatize(once))
+
+    def test_unknown_capitalized_token_is_not_an_entity(self):
+        # Capitalization alone (sentence starts, unknown proper nouns) must
+        # not fabricate PERSON/LOCATION tags.
+        toks = CoreNLPFeatureExtractor([1]).apply_item(
+            "Zorblax visited Quuxington yesterday"
+        )
+        assert "PERSON" not in toks and "LOCATION" not in toks
+        assert "zorblax" in toks and "quuxington" in toks
+
+    def test_unknown_org_by_suffix_pattern(self):
+        # The ORGANIZATION rule is a *pattern* (Capitalized + org suffix),
+        # so it must fire for names far outside any table.
+        toks = CoreNLPFeatureExtractor([1]).apply_item(
+            "Frobnicatex Corp announced a merger with Zyqqly University"
+        )
+        assert toks.count("ORGANIZATION") >= 2
+
+    def test_mixed_junk_never_crashes_and_stays_normalized(self):
+        docs = [
+            "xX9__zz!! 123,456 @@@ ~~~",
+            "élève straße 中文 words",
+            "a" * 300 + " " + "'''" + " don't",
+            "",
+            "...!?.",
+        ]
+        out = CoreNLPFeatureExtractor([1, 2])(docs)
+        assert len(out) == len(docs)
+        for grams in out:
+            for g in grams:
+                for tok in g.split(" "):
+                    # every token is an entity tag or lowercase alnum
+                    assert tok in ("PERSON", "LOCATION", "ORGANIZATION", "NUMBER") or (
+                        tok == tok.lower() and tok.replace("'", "").isalnum()
+                    ), tok
+
+    def test_numeric_shapes_tag_as_number(self):
+        toks = CoreNLPFeatureExtractor([1]).apply_item(
+            "raised 4,200 units worth 3.14 each in 2026"
+        )
+        assert toks.count("NUMBER") == 3

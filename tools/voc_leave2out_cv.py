@@ -1,0 +1,35 @@
+"""Leave-2-out CV over the reference 10-image VOC fixture (ACCURACY.md §2).
+
+Run: env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python tools/voc_leave2out_cv.py
+"""
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from keystone_tpu.loaders.image_loaders import voc_loader, MultiLabeledImages
+from keystone_tpu.workloads.voc_sift_fisher import SIFTFisherConfig, run
+
+data = voc_loader("/root/reference/src/test/resources/images/voc",
+                  "/root/reference/src/test/resources/images/voclabels.csv")
+n = len(data)
+print(f"{n} images; labels per image: {data.labels}")
+conf = SIFTFisherConfig(lam=0.05, desc_dim=16, vocab_size=8,
+                        num_pca_samples=6000, num_gmm_samples=6000)
+rng = np.random.default_rng(0)
+perm = rng.permutation(n)
+fold_maps, fold_details = [], []
+for f in range(5):
+    test_idx = set(perm[2*f:2*f+2].tolist())
+    tr = [i for i in range(n) if i not in test_idx]
+    te = sorted(test_idx)
+    sub = lambda idx: MultiLabeledImages([data.images[i] for i in idx],
+                                         [data.labels[i] for i in idx],
+                                         [data.filenames[i] for i in idx])
+    res = run(conf, sub(tr), sub(te))
+    train_classes = set(c for i in tr for c in data.labels[i])
+    test_classes = sorted(set(c for i in te for c in data.labels[i]))
+    # AP over classes present in the test fold AND learnable (seen in train)
+    scored = [c for c in test_classes if c in train_classes]
+    aps = [res["aps"][c] for c in scored]
+    fold_maps.append(float(np.mean(aps)) if aps else float("nan"))
+    fold_details.append((te, test_classes, scored, [round(float(a),3) for a in aps]))
+    print(f"fold {f}: test={te} test_classes={test_classes} scored={scored} aps={fold_details[-1][3]} foldMAP={fold_maps[-1]:.3f}")
+print(f"mean held-out MAP over 5 folds: {np.nanmean(fold_maps):.4f}")
