@@ -158,3 +158,26 @@ class TestBlockWeightedLeastSquares:
         est = BlockWeightedLeastSquaresEstimator(4, 1, 0.1, 0.3)
         with pytest.raises(ValueError, match="no examples"):
             est.fit(jnp.asarray(feats), jnp.asarray(labels))
+
+
+def test_regroup_plan_matches_host_sort(rng, mesh42):
+    """The all_to_all class-regroup (each row crosses the ICI once) must
+    reproduce the host-side sort+pad exactly, including the zero tail."""
+    import jax
+    from keystone_tpu.parallel.mesh import DATA_AXIS, row_sharding
+    from keystone_tpu.solvers.weighted import _RegroupPlan
+
+    d_size = mesh42.shape[DATA_AXIS]
+    n, n_src, cols = 37, 40, 5          # n_src divisible by data axis (4)
+    assert n_src % d_size == 0
+    p_tot = 48                           # sorted rows + zero tail, divisible
+    x_host = rng.normal(size=(n_src, cols)).astype(np.float32)
+    class_idx = rng.integers(0, 6, n)
+    order = np.argsort(class_idx, kind="stable")
+
+    expect = np.zeros((p_tot, cols), np.float32)
+    expect[:n] = x_host[order]
+
+    x_dev = jax.device_put(jnp.asarray(x_host), row_sharding(mesh42))
+    got = _RegroupPlan(order, n_src, p_tot, d_size).apply(mesh42, x_dev)
+    np.testing.assert_array_equal(np.asarray(got), expect)

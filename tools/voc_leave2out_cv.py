@@ -3,6 +3,7 @@
 Run: env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python tools/voc_leave2out_cv.py
 """
 import sys, os
+import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from keystone_tpu.loaders.image_loaders import voc_loader, MultiLabeledImages
 from keystone_tpu.workloads.voc_sift_fisher import SIFTFisherConfig, run
