@@ -98,8 +98,16 @@ class _RegroupPlan:
         self.d = d
         self.m_pad = m_pad
         self.rows_out = rows_out
-        self.send_idx = jnp.asarray(send)
-        self.recv_idx = jnp.asarray(recv)
+        # Skew guard: buckets pad to the GLOBAL max m_pad, so a
+        # class-correlated input order (near-identity permutation) would
+        # make the per-device exchange buffer [d*m_pad, cols] approach the
+        # full unsharded block — exactly the slab the chunked fallback
+        # exists to bound.  Usable only while padding stays within 2x of
+        # optimal; an unusable plan allocates NO device buffers.
+        self.usable = d * m_pad <= 2 * rows_out
+        if self.usable:
+            self.send_idx = jnp.asarray(send)
+            self.recv_idx = jnp.asarray(recv)
         self._jitted = {}  # mesh -> compiled regroup (one per fit, reused per block)
 
     def apply(self, mesh, x):
@@ -410,13 +418,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                         order, n_src, p_tot, mesh.shape[DATA_AXIS]
                     )
                 plan = regroup_plans[n_src]
-                # Skew guard: buckets pad to the GLOBAL max m_pad, so a
-                # class-correlated input order (near-identity permutation)
-                # can make the per-device exchange buffer [d*m_pad, cols]
-                # approach the full unsharded block — exactly the slab the
-                # chunked fallback exists to bound.  Take the all_to_all
-                # only while padding stays within 2x of optimal.
-                if plan.d * plan.m_pad <= 2 * plan.rows_out:
+                if plan.usable:  # else: skew guard — chunked fallback below
                     return plan.apply(mesh, jax.device_put(x, row_shard))
 
             chunk_cols = max(1, _GATHER_COL_CHUNK // max(1, x.itemsize))
