@@ -415,6 +415,30 @@ def bench_stage_ops(rng):
     )
     out["pca_fit"] = {"n": 1 << 18, "d": 128, "dims": 64,
                       "seconds": round(per_iter, 4)}
+
+    # BWLS fit (reference BlockWeightedLeastSquares.scala:106-312) — the
+    # ImageNet pipeline's solver tail: class-sorted gather, fused per-block
+    # statistics + class-solve programs.  Steady-state wall (second fit
+    # reuses every compiled program).
+    from keystone_tpu.solvers.weighted import BlockWeightedLeastSquaresEstimator
+
+    n_b, d_b, c_b = 8192, 2048, 64
+    xw = jnp.asarray(rng.normal(size=(n_b, d_b)).astype(np.float32))
+    yw = jnp.asarray(
+        2.0 * np.eye(c_b)[rng.integers(0, c_b, n_b)] - 1.0, jnp.float32
+    )
+    bwls = BlockWeightedLeastSquaresEstimator(
+        1024, num_iter=1, lam=0.01, mixture_weight=0.5
+    )
+    m0 = bwls.fit(xw, yw)
+    float(sum(jnp.sum(x) for x in m0.xs))  # warm + sync
+    t0 = time.perf_counter()
+    m1 = bwls.fit(xw, yw)
+    float(sum(jnp.sum(x) for x in m1.xs))
+    out["bwls_fit"] = {
+        "n": n_b, "d": d_b, "classes": c_b,
+        "wall_seconds": round(time.perf_counter() - t0, 3),
+    }
     return out
 
 

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from ..core.pipeline import Transformer, node
 from ..solvers.gmm import GaussianMixtureModel, _log_resp
+from ..utils.platform import use_pallas_kernels
 
 
 def _fv_from_stats(s0, s1, s2, means, variances, weights, n_valid):
@@ -50,14 +51,12 @@ def _fv_from_stats(s0, s1, s2, means, variances, weights, n_valid):
 
 
 def _use_pallas() -> bool:
-    """Opt-in (KEYSTONE_PALLAS=1): the hand-written fused kernel MEASURED
-    SLOWER than XLA's own fusion on the production shape (0.95 vs 1.61 ms —
-    see ops/fv_pallas.py docstring), so the XLA path is the default by
-    evidence, and the kernel remains available for shapes where the balance
-    tips (much larger vocab K)."""
-    return os.environ.get("KEYSTONE_PALLAS", "").strip() == "1" and (
-        jax.default_backend() == "tpu"
-    )
+    """Opt-in (KEYSTONE_PALLAS=1, shared gate utils/platform.py): the
+    hand-written fused kernel MEASURED SLOWER than XLA's own fusion on the
+    production shape (0.95 vs 1.61 ms — see ops/fv_pallas.py docstring), so
+    the XLA path is the default by evidence, and the kernel remains
+    available for shapes where the balance tips (much larger vocab K)."""
+    return use_pallas_kernels()
 
 
 def fisher_vector(descriptors, means, variances, weights, mask=None):

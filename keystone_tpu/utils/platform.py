@@ -1,0 +1,19 @@
+"""Platform/runtime gates shared across ops."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def use_pallas_kernels() -> bool:
+    """Opt-in gate (KEYSTONE_PALLAS=1, TPU backend only) for the
+    hand-written Pallas kernels that MEASURED SLOWER than XLA's own fusion
+    on their production shapes and are therefore not the defaults — see
+    ops/fv_pallas.py and ops/rect_pool_pallas.py for the measured verdicts.
+    One shared gate so every opt-in kernel engages under the same
+    condition."""
+    return os.environ.get("KEYSTONE_PALLAS", "").strip() == "1" and (
+        jax.default_backend() == "tpu"
+    )

@@ -13,9 +13,14 @@ TPU-native deviations from the reference (semantics preserved):
   materializing all ~36M patches in HBM would be absurd, so we window a
   random subset of images large enough to oversample the requested patch
   count 4x, then sample patches from those (statistically equivalent).
-* Featurization runs as one jitted chunk-batched program — conv, rectify,
-  pool, scale fuse into a single XLA executable on the MXU; only the final
+* Featurization runs as one jitted chunk-batched program — by default the
+  fused compact-activation form (ops/conv_fused.FusedConvFeaturizer: conv
+  epilogue stores bf16, pos/neg pools fuse their rectifier reads —
+  measured 2.4-2.8x the op-by-op chain, ROOFLINE.md); only the final
   [chunk, d] feature block leaves the device loop.
+* The solve is ONE compiled program (solvers/block._fused_bcd_fit):
+  centering, grams, Cholesky factors and the scanned BCD epochs fuse into
+  a single XLA executable.
 """
 
 from __future__ import annotations

@@ -88,3 +88,29 @@ def test_fused_no_normalization_no_means(rng):
         )(imgs)
     )
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5 * np.abs(ref).max())
+
+
+def test_pallas_rect_pool_matches_xla(rng, monkeypatch):
+    """The opt-in Pallas rect+pool stage (KEYSTONE_PALLAS=1) must match the
+    XLA two-reduce_window form — it is kept as a measured-slower template
+    (ops/rect_pool_pallas.py verdict), so correctness is its whole value.
+    The reference is pinned to the XLA branch (env var cleared) so this
+    never degenerates into comparing the kernel with itself."""
+    from keystone_tpu.ops.rect_pool_pallas import rect_pool_pallas
+
+    monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
+    imgs = jnp.asarray(rng.uniform(0, 255, (4, 32, 32, 3)).astype(np.float32))
+    filters = jnp.asarray(rng.normal(size=(24, 6, 6, 3)).astype(np.float32))
+    means = jnp.asarray(rng.normal(size=(108,)).astype(np.float32))
+    node_ = FusedConvFeaturizer(
+        filters, whitener_means=means, pool_stride=13, pool_size=14,
+        alpha=0.25, activation_dtype=jnp.float32,
+    )
+    ref = np.asarray(node_(imgs))
+    got = np.asarray(
+        rect_pool_pallas(
+            node_.conv(imgs), pool_stride=13, pool_size=14, alpha=0.25,
+            images_per_step=2, interpret=True,
+        )
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-4 * np.abs(ref).max())
