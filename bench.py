@@ -416,6 +416,40 @@ def bench_stage_ops(rng):
     out["pca_fit"] = {"n": 1 << 18, "d": 128, "dims": 64,
                       "seconds": round(per_iter, 4)}
 
+    # MnistRandomFFT featurization (reference MnistRandomFFT.scala:51-60):
+    # numFFTs random-sign -> padded-FFT -> rectify chains, zipped.
+    from keystone_tpu.core.pipeline import Pipeline
+    from keystone_tpu.ops.stats import (
+        CosineRandomFeatures, LinearRectifier, PaddedFFT, RandomSignNode,
+    )
+    from keystone_tpu.ops.util import ZipVectors
+
+    key = jax.random.PRNGKey(0)
+    chains = []
+    for _ in range(4):  # canonical --numFFTs 4
+        key, sub = jax.random.split(key)
+        chains.append(
+            Pipeline([RandomSignNode.create(784, sub), PaddedFFT(), LinearRectifier(0.0)])
+        )
+    mnist_batch = jnp.asarray(rng.normal(size=(4096, 784)).astype(np.float32))
+
+    def mnist_feat(b):
+        return ZipVectors.apply([c(b) for c in chains])
+
+    per_iter = timed_chain_auto(mnist_feat, mnist_batch, chain_len=64)
+    out["mnist_fft_featurize"] = {
+        "num_ffts": 4, "examples_per_sec": round(4096 / per_iter, 1),
+    }
+
+    # TIMIT cosine random features (reference TimitPipeline.scala:63-70):
+    # one [N, 440] x [440, D] gemm + cos per cosine batch.
+    crf = CosineRandomFeatures.create(440, 16384, 0.555, jax.random.PRNGKey(1))
+    timit_batch = jnp.asarray(rng.normal(size=(4096, 440)).astype(np.float32))
+    per_iter = timed_chain_auto(lambda b: crf(b), timit_batch, chain_len=64)
+    out["timit_cosine_features"] = {
+        "d_out": 16384, "examples_per_sec": round(4096 / per_iter, 1),
+    }
+
     # BWLS fit (reference BlockWeightedLeastSquares.scala:106-312) — the
     # ImageNet pipeline's solver tail: class-sorted gather, fused per-block
     # statistics + class-solve programs.  Steady-state wall (second fit

@@ -26,8 +26,6 @@ static shapes, SURVEY §7 "hard parts").
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 

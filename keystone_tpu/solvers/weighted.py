@@ -231,46 +231,105 @@ def _class_solves(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_max", "chunk", "num_classes", "mesh")
+    jax.jit,
+    static_argnames=("num_iter", "n_max", "chunk", "num_classes", "widths", "mesh"),
 )
-def _bwls_block_pass(
-    xb_pad, res_pad, seg_ids, starts, counts, counts_f,
-    pop_cov, pop_mean, joint_means, residual_mean, model,
-    nvalid, lam, w,
-    n_max: int, chunk: int, num_classes: int, mesh=None,
+def _fused_bwls_fit(
+    blocks, labels_sorted, valid, seg_ids, starts, counts, counts_f,
+    joint_label_mean, nvalid, lam, w,
+    num_iter: int, n_max: int, chunk: int, num_classes: int, widths, mesh,
 ):
-    """One FUSED block update of a BWLS pass: population XᵀR gram, the
-    class-solve sweep, the model update, the residual update and the new
-    residual class means as a single compiled program — round 3 ran these
-    as ~5 eager dispatches per block per pass over a ~126 ms-round-trip
-    transport.  (reference :228-311: one statistics job + one solve +
-    residual update per block per pass)."""
-    n = nvalid.astype(xb_pad.dtype)
-    pop_xtr = xb_pad.T @ res_pad / n
-    dw = _class_solves(
-        xb_pad, res_pad, starts, counts, pop_cov, pop_mean, pop_xtr,
-        joint_means, residual_mean, model, lam, w, n_max, chunk, mesh,
-    )
-    model_new = model + dw
-    res_new = res_pad - xb_pad @ dw
-    residual_mean_new = _residual_class_means(
-        res_new, seg_ids, counts_f, num_classes
-    )
-    return model_new, res_new, residual_mean_new
+    """The ENTIRE BWLS solve as one compiled program (the
+    BlockLeastSquares treatment, solvers/block._fused_bcd_fit): residual
+    init, per-block population statistics (computed once, cached across
+    passes like the reference's persisted grams), ``num_iter`` passes of a
+    lax.scan over blocks (population XᵀR gram + class-solve sweep + model
+    and residual updates + residual class means), and the joint-means
+    intercept — round 3 ran ~5 eager dispatches per block per pass over a
+    ~126 ms-round-trip transport.  (reference :134-311.)
 
+    blocks: tuple of sorted+padded [P, d_i] arrays; ``widths`` their static
+    column counts.  Blocks zero-pad to a common width; pad columns get a
+    unit diagonal shift on the population covariance (scaled by (1-w) > 0
+    in the joint normal equations), so their solutions are exactly zero and
+    every batched solve stays nonsingular even at lam=0.
 
-@functools.partial(jax.jit, static_argnames=("num_classes",))
-def _bwls_block_stats(xb_pad, seg_ids, counts_f, nvalid, w, num_classes: int):
-    """Per-block population statistics, fused into one program (the
-    reference's per-block treeReduce job, :134-160): population mean,
-    covariance, and the mixture joint means."""
-    n = nvalid.astype(xb_pad.dtype)
-    pop_mean = jnp.sum(xb_pad, axis=0) / n
-    ata = xb_pad.T @ xb_pad
-    pop_cov = ata / n - jnp.outer(pop_mean, pop_mean)
-    class_means = _class_sums(xb_pad, seg_ids, num_classes) / counts_f[:, None]
-    joint_means = w * class_means + (1.0 - w) * pop_mean
-    return pop_cov, pop_mean, joint_means
+    Memory note: the scan-friendly stacked [B, P, bs] tensor transiently
+    doubles the design matrix's footprint while the input blocks are still
+    live (donation cannot alias differently-sized buffers into a stack).
+    XLA frees the inputs after the stack op; at scales where even the
+    transient matters, lower ``block_size`` so per-block buffers amortize.
+
+    Returns (models [B, bs, C], intercept [C]).
+    """
+    bs = max(widths)
+    dtype = labels_sorted.dtype
+    n = nvalid.astype(dtype)
+
+    stacked = jnp.stack(
+        [
+            jnp.pad(blk, ((0, 0), (0, bs - wd))) if wd < bs else blk
+            for blk, wd in zip(blocks, widths)
+        ]
+    )  # [B, P, bs]
+    row_spec = None
+    if mesh is not None:
+        row_spec = NamedSharding(mesh, P(None, DATA_AXIS, None))
+        stacked = jax.lax.with_sharding_constraint(stacked, row_spec)
+
+    res = (labels_sorted - joint_label_mean) * valid
+    rmean = _residual_class_means(res, seg_ids, counts_f, num_classes)
+
+    pad_diag = jnp.stack(
+        [(jnp.arange(bs) >= wd).astype(dtype) for wd in widths]
+    )  # [B, bs] — 1.0 on pad columns
+
+    def stats_one(carry, inp):
+        xb, pd = inp
+        pop_mean = jnp.sum(xb, axis=0) / n
+        pop_cov = xb.T @ xb / n - jnp.outer(pop_mean, pop_mean) + jnp.diag(pd)
+        class_means = _class_sums(xb, seg_ids, num_classes) / counts_f[:, None]
+        joint_means = w * class_means + (1.0 - w) * pop_mean
+        return carry, (pop_cov, pop_mean, joint_means)
+
+    _, (pop_covs, pop_means, joint_means_all) = jax.lax.scan(
+        stats_one, None, (stacked, pad_diag)
+    )
+
+    models = jnp.zeros((len(widths), bs, num_classes), dtype)
+
+    def block_step(carry, inp):
+        res, rmean = carry
+        xb, pop_cov, pop_mean, jm, model = inp
+        pop_xtr = xb.T @ res / n
+        dw = _class_solves(
+            xb, res, starts, counts, pop_cov, pop_mean, pop_xtr,
+            jm, rmean, model, lam, w, n_max, chunk, mesh,
+        )
+        model_new = model + dw
+        res_new = res - xb @ dw
+        rmean_new = _residual_class_means(res_new, seg_ids, counts_f, num_classes)
+        return (res_new, rmean_new), model_new
+
+    def one_pass(carry, _):
+        models, res, rmean = carry
+        (res, rmean), models = jax.lax.scan(
+            block_step,
+            (res, rmean),
+            (stacked, pop_covs, pop_means, joint_means_all, models),
+        )
+        return (models, res, rmean), None
+
+    (models, res, rmean), _ = jax.lax.scan(
+        one_pass, (models, res, rmean), None, length=num_iter
+    )
+
+    # Intercept from joint means (reference :307-311):
+    # b = jointLabelMean − Σ_d jointMeans[c, d] · W[d, c]
+    intercept = joint_label_mean - jnp.einsum(
+        "bcd,bdc->c", joint_means_all, models
+    )
+    return models, intercept
 
 
 @functools.partial(jax.jit, static_argnames=("num_classes",))
@@ -457,15 +516,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             labels_sorted = sort_pad(labels.astype(dtype))
         else:
             labels_sorted = sort_pad(np.asarray(labels, dtype))
-        # Pad rows gathered as zero would become -jointLabelMean; mask them
-        # so the residual tail is exactly zero and stays zero (the zero
-        # feature tail adds nothing on updates).
-        res_pad = (labels_sorted - joint_label_mean) * valid.astype(dtype)
-        residual_mean = _residual_class_means(
-            res_pad, seg_ids, counts_f, n_classes
-        )
 
-        models = [jnp.zeros((b.shape[1], n_classes), dtype) for b in blocks_padded]
         chunk = max(1, min(self.class_chunk, n_classes))
         if mesh is not None:
             # Round the chunk up to a model-axis multiple so the batched
@@ -473,36 +524,28 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             # a partial chunk are repeats of class 0, discarded afterwards).
             m_size = mesh.shape[MODEL_AXIS]
             chunk = -(-chunk // m_size) * m_size
-        block_stats: list[tuple | None] = [None] * len(blocks_padded)
-        lam_arr = jnp.asarray(self.lam, dtype)
-        w_arr = jnp.asarray(w, dtype)
 
-        nvalid_arr = jnp.asarray(n)
-        for _pass in range(self.num_iter):
-            for bi, xb_pad in enumerate(blocks_padded):
-                if block_stats[bi] is None:
-                    # one fused statistics program per block (cached
-                    # across passes, like the reference's persisted grams)
-                    block_stats[bi] = _bwls_block_stats(
-                        xb_pad, seg_ids, counts_f, nvalid_arr, w_arr, n_classes
-                    )
-                pop_cov, pop_mean, joint_means = block_stats[bi]
-                # one fused program per block per pass: XᵀR + class solves
-                # + model/residual updates + residual class means
-                models[bi], res_pad, residual_mean = _bwls_block_pass(
-                    xb_pad, res_pad, seg_ids, starts, counts, counts_f,
-                    pop_cov, pop_mean, joint_means, residual_mean,
-                    models[bi], nvalid_arr, lam_arr, w_arr,
-                    n_max, chunk, n_classes, mesh,
-                )
-
-        # Intercept from joint means (reference :307-311):
-        # b = jointLabelMean − Σ_d jointMeans[c, d] · W[d, c]
-        full_model = jnp.concatenate(models, axis=0)
-        joint_means_combined = jnp.concatenate(
-            [s[2] for s in block_stats], axis=1
-        )  # [C, D]
-        b = joint_label_mean - jnp.einsum(
-            "cd,dc->c", joint_means_combined, full_model
+        # The ENTIRE solve is one compiled program; the dispatches above
+        # (one regroup per block + labels) are the only others in a fit.
+        widths = tuple(int(b.shape[1]) for b in blocks_padded)
+        models_st, b = _fused_bwls_fit(
+            tuple(blocks_padded),
+            labels_sorted,
+            valid.astype(dtype),
+            seg_ids,
+            starts,
+            counts,
+            counts_f,
+            joint_label_mean,
+            jnp.asarray(n),
+            jnp.asarray(self.lam, dtype),
+            jnp.asarray(w, dtype),
+            self.num_iter,
+            n_max,
+            chunk,
+            n_classes,
+            widths,
+            mesh,
         )
-        return BlockLinearMapper(models, self.block_size, b)
+        model_list = [models_st[i, :wd] for i, wd in enumerate(widths)]
+        return BlockLinearMapper(model_list, self.block_size, b)

@@ -25,6 +25,10 @@ from keystone_tpu.workloads.cifar_random_patch import (
 
 
 def main():
+    # The probe's purpose is reproducing the ROOFLINE.md XLA-variant rows;
+    # a stray KEYSTONE_PALLAS=1 would silently swap in the opt-in kernel
+    # under the SHIPPED label.
+    os.environ.pop("KEYSTONE_PALLAS", None)
     conf = RandomCifarConfig(
         num_filters=100, patch_size=6, patch_steps=1, pool_size=14,
         pool_stride=13, alpha=0.25, whitener_size=20000, featurize_chunk=1024,
