@@ -39,7 +39,6 @@ default precision.
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import lax
 
 from ..core.pipeline import Transformer, node
 from ..utils.platform import use_pallas_kernels

@@ -3,7 +3,6 @@
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 
 from ..core.pipeline import LabelEstimator, Transformer, node
 from ..ops.stats import StandardScaler, StandardScalerModel

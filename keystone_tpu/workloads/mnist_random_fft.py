@@ -11,9 +11,9 @@ reference computes fftsPerBatch/numFFTBatches (:31-33).
 from __future__ import annotations
 
 import argparse
+from dataclasses import dataclass
 import math
 import time
-from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp

@@ -1,6 +1,5 @@
 """Evaluator tests (reference src/test/scala/evaluation/*Suite.scala)."""
 
-import numpy as np
 
 from keystone_tpu.evaluation.multiclass import (
     BinaryClassifierEvaluator,

@@ -5,7 +5,6 @@ formulations."""
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from keystone_tpu.ops.images import (
     Convolver,

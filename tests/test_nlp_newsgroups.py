@@ -21,7 +21,6 @@ from keystone_tpu.ops.sparse import (
 )
 from keystone_tpu.solvers.naive_bayes import NaiveBayesEstimator
 from keystone_tpu.workloads.newsgroups import NewsgroupsConfig, run
-from keystone_tpu.loaders.newsgroups import NewsgroupsData
 
 
 class TestStringNodes:

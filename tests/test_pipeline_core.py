@@ -6,7 +6,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu import (
-    Estimator,
     FunctionTransformer,
     Identity,
     Pipeline,
@@ -64,7 +63,7 @@ def test_then_estimator_closure_semantics():
 
 
 def test_then_label_estimator():
-    from keystone_tpu.core.pipeline import ChainedLabelEstimator, LabelEstimator
+    from keystone_tpu.core.pipeline import LabelEstimator
 
     class Thresh(LabelEstimator):
         def fit(self, data, labels):

@@ -14,7 +14,7 @@ from keystone_tpu.ops.stats import (
     StandardScaler,
     next_power_of_two,
 )
-from keystone_tpu.parallel.mesh import padded_shard_rows, use_mesh
+from keystone_tpu.parallel.mesh import padded_shard_rows
 from keystone_tpu.utils.stats import about_eq
 
 

@@ -2,7 +2,7 @@
 
 import numpy as np
 
-from keystone_tpu.loaders.timit import TimitFeaturesData, TimitSplit, timit_features_loader
+from keystone_tpu.loaders.timit import timit_features_loader
 from keystone_tpu.workloads.timit import TimitConfig, run
 
 
