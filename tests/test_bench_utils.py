@@ -1,0 +1,50 @@
+"""Unit tests for bench.py's measurement scaffolding (the parts that guard
+the round artifact — no TPU required)."""
+
+import bench
+
+
+def test_error_record_shape():
+    rec = bench._error_record(ValueError("x" * 500))
+    assert rec["error"].startswith("ValueError: ")
+    assert len(rec["error"]) <= 300
+
+
+def test_guarded_returns_error_record_not_exception():
+    def boom(_rng):
+        raise RuntimeError("chip fell over")
+
+    rec = bench._guarded(boom, None)
+    assert rec == {"error": "RuntimeError: chip fell over"}
+
+    def ok(_rng):
+        return {"v": 1}
+
+    assert bench._guarded(ok, None) == {"v": 1}
+
+
+def test_timed_chain_auto_retries_only_noise_floor(monkeypatch):
+    calls = []
+
+    def fake_timed_chain(fn, arg, chain_len, repeats=3):
+        calls.append(chain_len)
+        if chain_len < 64:
+            raise bench.NoiseFloorError("too short")
+        return 0.001
+
+    monkeypatch.setattr(bench, "timed_chain", fake_timed_chain)
+    assert bench.timed_chain_auto(None, None, chain_len=16) == 0.001
+    assert calls == [16, 32, 64]  # doubled until the floor cleared
+
+
+def test_timed_chain_auto_propagates_real_failures(monkeypatch):
+    def fake_timed_chain(fn, arg, chain_len, repeats=3):
+        raise RuntimeError("XlaRuntimeError: RESOURCE_EXHAUSTED")
+
+    monkeypatch.setattr(bench, "timed_chain", fake_timed_chain)
+    try:
+        bench.timed_chain_auto(None, None, chain_len=16)
+    except RuntimeError as e:
+        assert "RESOURCE_EXHAUSTED" in str(e)
+    else:
+        raise AssertionError("real failure was swallowed")
