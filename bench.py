@@ -180,6 +180,12 @@ def timed_chain_auto(fn, arg, chain_len: int, max_len: int = 2048) -> float:
             chain_len *= 2
 
 
+def one_hot_pm1(rng, n: int, k: int):
+    """+/-1 one-hot label matrix [n, k] — the reference workloads' label
+    encoding (ClassLabelIndicators: +1 true class, -1 elsewhere)."""
+    return jnp.asarray(2.0 * np.eye(k)[rng.integers(0, k, n)] - 1.0, jnp.float32)
+
+
 def compiled_cost(jitted_fn, *args) -> tuple[float | None, float | None]:
     """(FLOPs, HBM bytes accessed) of the compiled program from XLA's cost
     analysis — the roofline numerator and denominator.
@@ -272,10 +278,7 @@ def bench_cifar_featurize(rng):
     # first call is the compile warm-up, the second is the steady-state
     # wall-clock (dispatch + compute + one scalar pull, minus the measured
     # round-trip), and the chain measurement is device compute only.
-    labels = jnp.asarray(
-        2.0 * np.eye(10)[np.random.default_rng(1).integers(0, 10, n_bench)] - 1.0,
-        jnp.float32,
-    )
+    labels = one_hot_pm1(np.random.default_rng(1), n_bench, 10)
     est = BlockLeastSquaresEstimator(4096, num_iter=1, lam=10.0)
 
     def pull(model):
@@ -479,6 +482,35 @@ def bench_stage_ops(rng):
         per_iter = timed_chain_auto(lambda b: crf(b), timit_batch, chain_len=64)
         return {"d_out": 16384, "examples_per_sec": round(4096 / per_iter, 1)}
 
+    @stage("block_solve_multiblock")
+    def _():
+        # The scanned-BCD path of the fused block solve (reference
+        # BlockLinearMapper.scala:147-204 with 4 feature blocks x 2
+        # epochs): device compute via the serial chain, at a shape where
+        # the lax.scan over stacked blocks actually iterates.
+        from keystone_tpu.solvers.block import _fused_bcd_fit
+
+        n_s, d_s, bs_s, k_s = 1024, 3200, 800, 10
+        xs_ = jnp.asarray(rng.normal(size=(n_s, d_s)).astype(np.float32))
+        ys_ = one_hot_pm1(rng, n_s, k_s)
+        widths = (bs_s,) * (d_s // bs_s)
+
+        def solve_fn(f):
+            blocks = tuple(
+                f[:, i * bs_s : (i + 1) * bs_s] for i in range(d_s // bs_s)
+            )
+            models, _, _ = _fused_bcd_fit(
+                blocks, ys_, jnp.float32(1.0), f.shape[0], 2, widths, None
+            )
+            return models
+
+        per_iter = timed_chain_auto(solve_fn, xs_, chain_len=64)
+        return {
+            "n": n_s, "d": d_s, "blocks": len(widths), "epochs": 2,
+            "device_seconds": round(per_iter, 5),
+            "examples_per_sec": round(n_s / per_iter, 1),
+        }
+
     @stage("bwls_fit")
     def _():
         # BWLS fit (reference BlockWeightedLeastSquares.scala:106-312) —
@@ -490,9 +522,7 @@ def bench_stage_ops(rng):
 
         n_b, d_b, c_b = 8192, 2048, 64
         xw = jnp.asarray(rng.normal(size=(n_b, d_b)).astype(np.float32))
-        yw = jnp.asarray(
-            2.0 * np.eye(c_b)[rng.integers(0, c_b, n_b)] - 1.0, jnp.float32
-        )
+        yw = one_hot_pm1(rng, n_b, c_b)
         bwls = BlockWeightedLeastSquaresEstimator(
             1024, num_iter=1, lam=0.01, mixture_weight=0.5
         )
