@@ -290,9 +290,18 @@ def bench_cifar_featurize(rng):
         )
 
     pull(est.fit(feats, labels))  # compile warm-up
+    # The timed fit gets a PERTURBED input: re-dispatching the identical
+    # program on identical inputs can be served by the transport's dedup
+    # cache (observed: solve_seconds collapsing to ~0), the same trap the
+    # chain methodology defeats for the featurize timings.  RELATIVE
+    # perturbation (an absolute epsilon is below f32 ULP for values >= 32
+    # and would round away); synced by a scalar pull, the one sync this
+    # transport honors (see the pull() note above).
+    feats_t = feats * jnp.float32(1.0 + 1e-6)
+    float(jnp.sum(feats_t[0]))
     lat = roundtrip_latency()
     t1 = time.perf_counter()
-    pull(est.fit(feats, labels))
+    pull(est.fit(feats_t, labels))
     solve_secs = max(time.perf_counter() - t1 - lat, 1e-9)
 
     # Device-compute-only: the same fused fit program in a serial chain.
