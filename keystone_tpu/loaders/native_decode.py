@@ -89,6 +89,18 @@ def available() -> bool:
     return _load() is not None
 
 
+def reset() -> None:
+    """Forget the cached build/load outcome (under the module lock) so the
+    next decode re-evaluates the ``KEYSTONE_NATIVE_DECODE`` gate and the
+    library state.  Public hook for benchmarks/tests that toggle the env
+    var to compare native-vs-PIL paths — poking ``_tried``/``_lib``
+    directly would race any live decode thread."""
+    global _lib, _tried
+    with _lock:
+        _tried = False
+        _lib = None
+
+
 def decode_jpeg_native(data: bytes) -> np.ndarray | None:
     """JPEG bytes -> f32[H, W, 3] BGR in [0, 255], or None when the stream
     is corrupt, rejected (<36 px), or the native library is unavailable.

@@ -10,9 +10,11 @@ This environment has no CoreNLP models, so the same contract is implemented
 host-side with deterministic rules:
 
 * sentence splitting on terminal punctuation;
-* an English suffix lemmatizer (irregular table + -ies/-es/-s, -ing, -ed
-  with consonant-doubling and silent-e restoration) — covers the reference
-  suite's cases (jumping->jump, snakes->snake, hunted->hunt, ...);
+* an English suffix lemmatizer (irregular table + -ies/-ied/-oes/-es/-s,
+  -ing, -ed with consonant-doubling and silent-e restoration), with
+  Porter-style vowel-measure guards on every strip so the rules stay safe
+  on open vocabulary — covers the reference suite's cases
+  (jumping->jump, snakes->snake, hunted->hunt, ...);
 * gazetteer + shape NER: PERSON (common given names), LOCATION (countries,
   US states, major cities), ORGANIZATION (Corp/Inc/University ... suffix
   patterns), NUMBER for numeric tokens — matching the entity-type tokens
@@ -33,9 +35,14 @@ from ..core.pipeline import Transformer
 # Terminal punctuation only at a whitespace/end boundary — "3.14" is one
 # token, not a sentence break.
 _SENT_SPLIT = re.compile(r"[.!?]+(?=\s|$)")
-# Numbers keep internal , and . ("4,200", "3.14"); word tokens start with a
-# letter (a bare "'''" must not become an empty token after normalization).
-_TOKEN = re.compile(r"[0-9][0-9.,]*|[A-Za-z][A-Za-z0-9']*")
+# Numbers keep internal , and . only between digits ("4,200", "3.14" — but
+# "2026,Google" is two tokens); word tokens start with a letter (a bare "'''"
+# must not become an empty token after normalization).  Digit-led
+# alphanumerics ("3d", "90s", "4k") stay ONE token — neither split ("3","d")
+# nor tagged NUMBER.
+_TOKEN = re.compile(
+    r"[0-9](?:[0-9]|[.,](?=[0-9]))*(?:[A-Za-z][A-Za-z0-9']*)?|[A-Za-z][A-Za-z0-9']*"
+)
 _NON_ALNUM = re.compile(r"[^a-zA-Z0-9\s+]")
 _NUMERIC = re.compile(r"^[0-9][0-9,.]*$")
 
@@ -59,7 +66,7 @@ _IRREGULAR = {
     "lost": "lose", "sold": "sell", "sent": "send",
     "was": "be", "were": "be", "is": "be", "are": "be", "am": "be",
     "been": "be", "being": "be", "has": "have", "had": "have",
-    "does": "do", "did": "do", "done": "do",
+    "does": "do", "did": "do", "done": "do", "goes": "go",
     "men": "man", "women": "woman", "children": "child", "people": "person",
     "mice": "mouse", "geese": "goose", "feet": "foot", "teeth": "tooth",
     "better": "good", "best": "good", "worse": "bad", "worst": "bad",
@@ -76,8 +83,26 @@ _NO_STRIP = {
 }
 
 
+# Words ending consonant+"oes" that are o+"es" plurals of -oe nouns, not
+# -o nouns ("shoes" = shoe+s, not sho+es).
+_OE_PLURALS = {
+    "shoes", "canoes", "oboes", "tiptoes", "mistletoes", "throes", "floes",
+}
+
+
+def _has_vowel(stem: str) -> bool:
+    """Porter's *v* condition: a stem with no vowel ("bl" from "bling",
+    "z" from "zings") is not a word, so the suffix was not an inflection."""
+    return any(c in _VOWELS or c == "y" for c in stem)
+
+
 def lemmatize(word: str) -> str:
-    """Suffix-rule English lemmatizer (the FastNLPProcessor.lemmatize analog)."""
+    """Suffix-rule English lemmatizer (the FastNLPProcessor.lemmatize
+    analog): irregular table first, then suffix rules guarded by
+    Porter-style conditions — every strip requires the remaining stem to
+    contain a vowel (Porter's *v* measure guard), which is what keeps the
+    rules safe on OPEN vocabulary where a closed exception list cannot
+    anticipate every "bling"/"zings"-shaped token."""
     w = word.lower()
     if w in _IRREGULAR:
         return _IRREGULAR[w]
@@ -106,15 +131,24 @@ def lemmatize(word: str) -> str:
 
     if w.endswith("ies") and len(w) > 4:
         return w[:-3] + "y"
+    if w.endswith("ied") and len(w) > 4:  # carried -> carry, studied -> study
+        return w[:-3] + "y"
     if w.endswith("sses"):
         return w[:-2]
     if w.endswith(("ches", "shes", "xes", "zes")):
         return w[:-2]
+    if (
+        w.endswith("oes")
+        and len(w) > 4
+        and w[-4] not in _VOWELS
+        and w not in _OE_PLURALS
+    ):
+        return w[:-2]  # consonant+o takes -es: heroes/echoes/potatoes
     if w.endswith("s") and not w.endswith(("ss", "us", "is")):
-        return w[:-1]
-    if w.endswith("ing") and len(w) > 5:
+        return w[:-1] if _has_vowel(w[:-1]) else w
+    if w.endswith("ing") and len(w) > 5 and _has_vowel(w[:-3]):
         return _restore(w[:-3])
-    if w.endswith("ed") and len(w) > 4:
+    if w.endswith("ed") and len(w) > 4 and _has_vowel(w[:-2]):
         return _restore(w[:-2])
     return w
 
@@ -235,7 +269,15 @@ class CoreNLPFeatureExtractor(Transformer):
             for i, tok in enumerate(raw):
                 nxt = raw[i + 1].lower() if i + 1 < len(raw) else None
                 ent = _entity_type(tok, tok[:1].isupper(), nxt)
-                out.append(ent if ent is not None else normalize(lemmatize(tok)))
+                if ent is not None:
+                    out.append(ent)
+                elif tok[:1].isdigit():
+                    # digit-led mixed token ("90s", "3d"): unit/decade
+                    # notation, not an English inflection — don't let the
+                    # suffix lemmatizer strip it ("90s" -> "90")
+                    out.append(normalize(tok))
+                else:
+                    out.append(normalize(lemmatize(tok)))
             sentences.append(out)
         grams = []
         for n in self.orders:

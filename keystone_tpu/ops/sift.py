@@ -162,11 +162,13 @@ class SIFTExtractor(Transformer):
     -> ``[N, 128, num_desc]`` quantized descriptors as float32
     (reference SIFTExtractor.scala:27-34 returns DenseMatrix(128, numCols)).
 
-    ``compute_dtype`` (default bf16): storage dtype of the large per-scale
+    ``compute_dtype`` (default f32): storage dtype of the large per-scale
     intermediates — the [N, 8, H, W] orientation planes and the banded-gemm
     sampling tensors, the dominant HBM streams of this op (measured ~197
     MB/image of traffic in f32 at 256x256x4-scales; the op is memory-bound
-    at ~11 FLOP/byte, BENCH_r04 roofline).  Gemms accumulate f32 and the
+    at ~11 FLOP/byte, BENCH_r04 roofline).  Passing ``jnp.bfloat16`` (the
+    throughput workloads do — imagenet_sift_lcs_fv, voc_sift_fisher,
+    bench.py) halves that traffic: gemms accumulate f32 and the
     normalize/clamp/quantize tail runs f32, so the only effect is one
     rounding of intermediate values.  MEASURED vs the f32 chain (v5e,
     random-noise 256x256 images — the worst case for near-threshold bins):
@@ -174,12 +176,13 @@ class SIFTExtractor(Transformer):
     acceptance envelope (VLFeatSuite.scala:48-51) — with rare tail
     outliers up to ~13/255; throughput 4.3k -> 5.9k img/s (+35%) on the
     SIFT->PCA->FV chain, traffic 197 -> 126 MB/image.  One known whole-
-    descriptor failure mode: a descriptor whose pre-normalization norm
-    lands within bf16 rounding (~0.4%) of CONTRAST_THRESHOLD can flip the
-    zeroing decision vs the f32 chain, changing its entire 128-dim column
-    — such near-threshold (i.e. near-contrastless) descriptors carry
-    negligible signal, but parity-critical comparisons should pass
-    jnp.float32 for bit-level agreement with the f32 chain.
+    descriptor failure mode under bf16: a descriptor whose
+    pre-normalization norm lands within bf16 rounding (~0.4%) of
+    CONTRAST_THRESHOLD can flip the zeroing decision vs the f32 chain,
+    changing its entire 128-dim column — such near-threshold (i.e.
+    near-contrastless) descriptors carry negligible signal, which is why
+    the throughput workloads opt in; the OP default stays f32 so
+    parity-critical callers get bit-level agreement without asking.
     """
 
     def __init__(
@@ -188,7 +191,7 @@ class SIFTExtractor(Transformer):
         bin_size: int = 4,
         scales: int = 4,
         scale_step: int = 1,
-        compute_dtype=jnp.bfloat16,
+        compute_dtype=jnp.float32,
     ):
         self.step_size = step_size
         self.bin_size = bin_size

@@ -121,6 +121,13 @@ def _fused_bcd_fit(blocks, labels, lam, nvalid, num_iter: int, widths, mesh):
     (their gram rows are zero, so their solutions are exactly zero and the
     factorization stays positive-definite even at lam=0).
 
+    Memory note (mirrors _fused_bwls_fit): the stacked [B, N, bs] tensor —
+    and the centered copy ``a`` derived from it — transiently adds a full
+    design-matrix footprint while the input blocks are still live (donation
+    cannot alias differently-sized buffers into a stack).  XLA frees the
+    inputs after the stack op; at scales where even the transient matters,
+    lower ``block_size`` so per-block buffers amortize.
+
     With ``mesh``: rows shard over the data axis (grams lower to local
     MXU gram + ICI all-reduce), models/labels' class columns shard over the
     model axis — same layout as the round-3 eager path.

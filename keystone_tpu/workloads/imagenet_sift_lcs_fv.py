@@ -117,7 +117,12 @@ def sift_descriptor_buckets(
 ) -> dict:
     """SIFT branch descriptors (:40-94): SIFT -> BatchSignedHellinger.
     With a mesh each bucket batch is row-sharded over the data axis."""
-    sift = SIFTExtractor(scale_step=conf.sift_scale_step)
+    # bf16 intermediates: measured +35% chain throughput at 99.5%-within-1
+    # quantized-descriptor agreement (see SIFTExtractor docstring) — the
+    # throughput workload opts in; the op default stays f32.
+    sift = SIFTExtractor(
+        scale_step=conf.sift_scale_step, compute_dtype=jnp.bfloat16
+    )
     hell = SignedHellingerMapper()
     buckets = {}
     for shape, (idx, batch) in bucket_by_shape(images).items():

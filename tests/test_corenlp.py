@@ -150,3 +150,40 @@ class TestOpenVocabulary:
             "raised 4,200 units worth 3.14 each in 2026"
         )
         assert toks.count("NUMBER") == 3
+
+    def test_digit_led_mixed_tokens_stay_whole(self):
+        # "3d"/"90s"/"4k" are single tokens (not split "3","d"), are not
+        # NUMBER entities, and are not mangled by the suffix lemmatizer.
+        toks = CoreNLPFeatureExtractor([1]).apply_item(
+            "a 3d scene from the 90s in 4k"
+        )
+        assert "3d" in toks and "90s" in toks and "4k" in toks
+        assert "NUMBER" not in toks
+
+    def test_number_punctuation_does_not_glue_tokens(self):
+        # ','/'.' join digits only BETWEEN digits — a missing space after
+        # punctuation must not fuse a number onto the following word.
+        toks = CoreNLPFeatureExtractor([1]).apply_item(
+            "In 2026,Google announced"
+        )
+        assert "NUMBER" in toks and "ORGANIZATION" in toks
+
+    def test_porter_guard_cases(self):
+        # Vowel-measure guards + the -ied/-oes rules: open-vocab shapes the
+        # closed tables never listed.
+        cases = {
+            "carried": "carry",
+            "studied": "study",
+            "heroes": "hero",
+            "echoes": "echo",
+            "potatoes": "potato",
+            "shoes": "shoe",     # -oe plural exception
+            "toes": "toe",
+            "throes": "throe",
+            "floes": "floe",
+            "goes": "go",
+            "bling": "bling",    # no-vowel stem: not an inflection
+            "zings": "zing",
+        }
+        for word, lemma in cases.items():
+            assert lemmatize(word) == lemma, (word, lemmatize(word))
