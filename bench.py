@@ -309,7 +309,7 @@ def bench_cifar_featurize(rng):
 
     def solve_fn(f):
         models, _, _ = _fused_bcd_fit(
-            (f,), labels, jnp.float32(est.lam), f.shape[0], est.num_iter,
+            f, labels, jnp.float32(est.lam), f.shape[0], est.num_iter,
             (f.shape[1],), None,
         )
         return models[0]
@@ -507,11 +507,8 @@ def bench_stage_ops(rng):
         widths = (bs_s,) * (d_s // bs_s)
 
         def solve_fn(f):
-            blocks = tuple(
-                f[:, i * bs_s : (i + 1) * bs_s] for i in range(d_s // bs_s)
-            )
             models, _, _ = _fused_bcd_fit(
-                blocks, ys_, jnp.float32(1.0), f.shape[0], 2, widths, None
+                f, ys_, jnp.float32(1.0), f.shape[0], 2, widths, None
             )
             return models
 
@@ -526,7 +523,11 @@ def bench_stage_ops(rng):
     def _():
         # BWLS fit (reference BlockWeightedLeastSquares.scala:106-312) —
         # the ImageNet pipeline's solver tail, the whole solve one compiled
-        # program.  Steady-state wall (second fit reuses every program).
+        # program.  Beyond steady-state wall, the round-5 rigor ask: device
+        # seconds + cost analysis of the fused solve program itself, and a
+        # wall breakdown separating host prep / the regroup gather / the
+        # solve so the wall number is explained, not just quoted.
+        import keystone_tpu.solvers.weighted as wsolver
         from keystone_tpu.solvers.weighted import (
             BlockWeightedLeastSquaresEstimator,
         )
@@ -537,15 +538,311 @@ def bench_stage_ops(rng):
         bwls = BlockWeightedLeastSquaresEstimator(
             1024, num_iter=1, lam=0.01, mixture_weight=0.5
         )
-        m0 = bwls.fit(xw, yw)
-        float(sum(jnp.sum(x) for x in m0.xs))  # warm + sync
+
+        # Capture the exact arguments the fit hands the fused program so it
+        # can be AOT-timed in isolation (no duplicated preprocessing logic).
+        captured = {}
+        orig = wsolver._fused_bwls_fit
+
+        def capture(*args, **kw):
+            captured["args"], captured["kw"] = args, kw
+            return orig(*args, **kw)
+
+        wsolver._fused_bwls_fit = capture
+        try:
+            m0 = bwls.fit(xw, yw)  # warm: compiles every program + captures
+        finally:
+            wsolver._fused_bwls_fit = orig
+        float(sum(jnp.sum(x) for x in m0.xs))  # sync
+
+        # Steady-state wall of the WHOLE fit (perturbed input defeats
+        # transport dedup; relative perturbation per the solve-timing note).
+        xw_t = xw * jnp.float32(1.0 + 1e-6)
+        float(jnp.sum(xw_t[0]))
         t0 = time.perf_counter()
-        m1 = bwls.fit(xw, yw)
+        m1 = bwls.fit(xw_t, yw)
         float(sum(jnp.sum(x) for x in m1.xs))
-        return {"n": n_b, "d": d_b, "classes": c_b,
-                "wall_seconds": round(time.perf_counter() - t0, 3)}
+        wall = time.perf_counter() - t0
+
+        # Host prep: argmax pull + argsort + index builds, measured directly.
+        t0 = time.perf_counter()
+        ci = np.asarray(jnp.argmax(yw, axis=1))
+        np.argsort(ci, kind="stable")
+        host_prep = time.perf_counter() - t0
+
+        # The regroup gather (no-mesh fallback: one jnp.take per column
+        # chunk) timed as its own program on the same shape.
+        order_idx = jnp.asarray(np.random.default_rng(0).permutation(n_b))
+
+        def regroup(xx):
+            return jnp.take(xx, order_idx, axis=0, mode="fill", fill_value=0)
+
+        regroup_dev = timed_chain_auto(regroup, xw, chain_len=64)
+
+        # The fused solve program, AOT-compiled then executed once with a
+        # perturbed lam operand (same program, fresh input -> no dedup).
+        args, kw = captured["args"], captured["kw"]
+        lowered = orig.lower(*args, **kw)
+        compiled = lowered.compile()
+        flops, bytes_accessed = None, None
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            flops = float(ca.get("flops", 0.0)) or None
+            bytes_accessed = float(ca.get("bytes accessed", 0.0)) or None
+        except Exception:
+            pass
+        # args layout: (x, labels_sorted, valid, seg_ids, starts, counts,
+        # counts_f, joint_label_mean, nvalid, lam, w, ...statics); perturb
+        # lam (index 9) so repeats are never bit-identical invocations.
+        solve_dev = timed_chain_auto(
+            lambda xs: orig(
+                xs, *args[1:9], args[9] * jnp.float32(1.000001), *args[10:],
+                **kw,
+            )[0],
+            args[0],
+            chain_len=16,
+        )
+        lat = roundtrip_latency()
+        return {
+            "n": n_b, "d": d_b, "classes": c_b,
+            "wall_seconds": round(wall, 3),
+            "solve_device_seconds": round(solve_dev, 4),
+            "regroup_device_seconds": round(regroup_dev, 4),
+            "host_prep_seconds": round(host_prep, 4),
+            "roundtrip_latency_seconds": round(lat, 4),
+            "solve_flops": flops,
+            "solve_bytes_accessed": bytes_accessed,
+            # wall ≈ host prep + regroup + solve + ~2 dispatch round-trips
+            # (argmax pull; final model pull) + enqueue overhead.
+            "wall_explained_seconds": round(
+                host_prep + regroup_dev + solve_dev + 2 * lat, 3
+            ),
+        }
+
+    @stage("gmm_em_fit")
+    def _():
+        # The FULL GMM fit — init + EM to convergence, one compiled loop
+        # (reference EncEval.cxx:122-151 runs the whole fit driver-side) —
+        # at the ImageNet sampling shape (the 1e6-sample EM cap,
+        # ImageNetSiftLcsFV.scala:85-86).  Planted mixture so the
+        # convergence path is realistic rather than one-step.
+        from keystone_tpu.solvers.gmm import GaussianMixtureModelEstimator
+
+        n_g, d_g, k_g = 1_000_000, 64, 16
+        kc, kx, ka = jax.random.split(jax.random.PRNGKey(7), 3)
+
+        @jax.jit
+        def make_data():
+            centers = jax.random.normal(kc, (k_g, d_g)) * 2.0
+            assign = jax.random.randint(ka, (n_g,), 0, k_g)
+            return centers[assign] + jax.random.normal(kx, (n_g, d_g)) * 0.5
+
+        x = make_data()  # device-generated: nothing crosses the tunnel
+        x.block_until_ready()
+        est = GaussianMixtureModelEstimator(k_g)
+        est.fit(x)  # warm: compiles init gather + the while_loop fit
+        x_t = x * jnp.float32(1.0 + 1e-6)  # dedup-defeating perturbation
+        float(jnp.sum(x_t[0]))
+        t0 = time.perf_counter()
+        est.fit(x_t)
+        iters = int(est.last_iterations)  # the one host pull = the sync
+        dt = time.perf_counter() - t0
+        return {
+            "n": n_g, "d": d_g, "k": k_g,
+            "iterations": iters,
+            "fit_wall_seconds": round(dt, 3),
+            "seconds_per_iter": round(dt / max(1, iters), 4),
+        }
 
     return out
+
+
+def bench_solve_at_scale(rng):
+    """The fused BCD solve at the largest single-chip-HBM shape that fits
+    (VERDICT r4 #2): the flagship one-program claim exercised where memory
+    behavior actually matters, not at toy shapes.  Data is device-generated
+    (nothing crosses the tunnel), the program is AOT-compiled so the timed
+    dispatch is pure execution, and XLA's compiled memory analysis reports
+    the true peak footprint.  Failed (OOM) shapes are recorded — the
+    largest-fittable boundary is part of the result.  The reference's
+    north-star solve is 1.25M x 256k spread across a cluster
+    (ImageNetSiftLcsFV.scala:186-188); per chip that is ~40 GB of design
+    matrix per 16 GB-HBM v5e at f32, so single-chip proof means the
+    largest shape HBM admits, with the mesh path scaling rows/classes out.
+    """
+    from keystone_tpu.solvers.block import _fused_bcd_fit
+
+    k_cls = 128
+    bs = 4096
+    shapes = [  # (n, d) descending footprint; ~GB = n*d*4/2**30
+        (262144, 16384),  # 16.0 GB design matrix — expected OOM, recorded
+        (196608, 16384),  # 12.0 GB
+        (163840, 16384),  # 10.0 GB
+        (131072, 16384),  # 8.0 GB
+        (131072, 8192),   # 4.0 GB
+    ]
+    attempts = []
+    result = None
+    for n, d in shapes:
+        widths = (bs,) * (d // bs)
+        try:
+            key = jax.random.PRNGKey(n % 97)
+
+            @jax.jit
+            def make(key=key, n=n, d=d):
+                kx, ky = jax.random.split(key)
+                x = jax.random.normal(kx, (n, d), jnp.float32)
+                cls = jax.random.randint(ky, (n,), 0, k_cls)
+                y = 2.0 * jax.nn.one_hot(cls, k_cls, dtype=jnp.float32) - 1.0
+                return x, y
+
+            x, y = make()
+            x.block_until_ready()
+            lam = jnp.float32(10.0)
+            nv = jnp.int32(n)
+            lowered = _fused_bcd_fit.lower(
+                x, y, lam, nv, 1, widths, None
+            )
+            compiled = lowered.compile()
+            flops = bytes_accessed = None
+            try:
+                ca = compiled.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0]
+                flops = float(ca.get("flops", 0.0)) or None
+                bytes_accessed = float(ca.get("bytes accessed", 0.0)) or None
+            except Exception:
+                pass
+            mem = {}
+            try:
+                ma = compiled.memory_analysis()
+                mem = {
+                    "argument_gb": round(ma.argument_size_in_bytes / 2**30, 2),
+                    "temp_gb": round(ma.temp_size_in_bytes / 2**30, 2),
+                    "output_gb": round(ma.output_size_in_bytes / 2**30, 2),
+                }
+            except Exception:
+                pass
+            # First execution of a fresh AOT executable: nothing to dedup.
+            t0 = time.perf_counter()
+            models, label_mean, means = compiled(x, y, lam, nv)
+            float(jnp.sum(models))  # scalar pull = the sync
+            dt = time.perf_counter() - t0
+            # Second run, perturbed lam operand (same program, fresh input).
+            t0 = time.perf_counter()
+            models, _, _ = compiled(x, y, lam * jnp.float32(1.000001), nv)
+            float(jnp.sum(models))
+            dt = min(dt, time.perf_counter() - t0)
+            result = {
+                "n": n, "d": d, "block_size": bs, "classes": k_cls,
+                "blocks": len(widths),
+                "design_matrix_gb": round(n * d * 4 / 2**30, 2),
+                "wall_seconds": round(dt, 3),
+                "examples_per_sec": round(n / dt, 1),
+                "flops": flops,
+                "bytes_accessed": bytes_accessed,
+                "flops_per_sec": round(flops / dt, 3) if flops else None,
+                "memory_analysis": mem,
+            }
+            break
+        except Exception as e:  # noqa: BLE001 — OOM boundary is data
+            attempts.append({
+                "n": n, "d": d,
+                "design_matrix_gb": round(n * d * 4 / 2**30, 2),
+                "error": f"{type(e).__name__}: {e}"[:160],
+            })
+            x = y = None  # free HBM before the next probe
+    if result is None:
+        return {"error": "no probed shape fit", "attempts": attempts}
+    result["oom_attempts"] = attempts
+    result["bwls"] = _guarded(_bench_bwls_at_scale, rng)
+    return result
+
+
+def _bench_bwls_at_scale(rng):
+    """_fused_bwls_fit at a scale that stresses HBM (VERDICT r4 #2): the
+    whole class-weighted fit on a multi-GB device-generated design matrix,
+    with the fused program AOT-isolated via argument capture."""
+    import keystone_tpu.solvers.weighted as wsolver
+    from keystone_tpu.solvers.weighted import BlockWeightedLeastSquaresEstimator
+
+    n, d, c = 131072, 8192, 256
+
+    @jax.jit
+    def make():
+        kx, ky = jax.random.split(jax.random.PRNGKey(11))
+        x = jax.random.normal(kx, (n, d), jnp.float32)
+        cls = jax.random.randint(ky, (n,), 0, c)
+        y = 2.0 * jax.nn.one_hot(cls, c, dtype=jnp.float32) - 1.0
+        return x, y
+
+    x, y = make()
+    x.block_until_ready()
+    est = BlockWeightedLeastSquaresEstimator(
+        4096, num_iter=1, lam=0.01, mixture_weight=0.25
+    )
+    captured = {}
+    orig = wsolver._fused_bwls_fit
+
+    def capture(*args, **kw):
+        captured["args"], captured["kw"] = args, kw
+        return orig(*args, **kw)
+
+    wsolver._fused_bwls_fit = capture
+    try:
+        m0 = est.fit(x, y)
+    finally:
+        wsolver._fused_bwls_fit = orig
+    float(sum(jnp.sum(b) for b in m0.xs))  # sync the warm fit
+
+    x_t = x * jnp.float32(1.0 + 1e-6)
+    float(jnp.sum(x_t[0]))
+    t0 = time.perf_counter()
+    m1 = est.fit(x_t, y)
+    float(sum(jnp.sum(b) for b in m1.xs))
+    wall = time.perf_counter() - t0
+
+    args, kw = captured["args"], captured["kw"]
+    compiled = orig.lower(*args, **kw).compile()
+    flops = bytes_accessed = None
+    mem = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0)) or None
+        bytes_accessed = float(ca.get("bytes accessed", 0.0)) or None
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        mem = {
+            "argument_gb": round(ma.argument_size_in_bytes / 2**30, 2),
+            "temp_gb": round(ma.temp_size_in_bytes / 2**30, 2),
+            "output_gb": round(ma.output_size_in_bytes / 2**30, 2),
+        }
+    except Exception:
+        pass
+    # One timed execution of the solve program alone (perturbed lam).
+    t0 = time.perf_counter()
+    out = compiled(*[
+        a * jnp.float32(1.000001) if i == 9 else a for i, a in enumerate(args)
+        if i < 11
+    ])
+    float(jnp.sum(out[0]))
+    solve_exec = time.perf_counter() - t0
+    return {
+        "n": n, "d": d, "classes": c, "block_size": 4096,
+        "design_matrix_gb": round(n * d * 4 / 2**30, 2),
+        "fit_wall_seconds": round(wall, 3),
+        "solve_exec_seconds": round(solve_exec, 3),
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "flops_per_sec": round(flops / solve_exec, 3) if flops else None,
+        "memory_analysis": mem,
+    }
 
 
 def bench_decode(rng):
@@ -650,6 +947,7 @@ def main():
     fv = _guarded(bench_imagenet_fv_featurize, rng)
     stages = _guarded(bench_stage_ops, rng)
     decode = _guarded(bench_decode, rng)
+    at_scale = _guarded(bench_solve_at_scale, rng)
 
     value = round(cifar["images_per_sec"] / n_chips, 2)
     prior = prior_bench_value("random_patch_cifar_featurize")
@@ -704,6 +1002,7 @@ def main():
                         }
                     ),
                     "stage_ops": stages,
+                    "solve_at_scale": at_scale,
                     "jpeg_decode": decode,
                 },
             }

@@ -16,6 +16,7 @@ from typing import Callable, Sequence
 import jax
 import jax.numpy as jnp
 import jax.scipy.linalg as jsl
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.pipeline import Identity, LabelEstimator, Transformer
@@ -103,7 +104,7 @@ jax.tree_util.register_pytree_node(
 @functools.partial(
     jax.jit, static_argnames=("num_iter", "widths", "mesh")
 )
-def _fused_bcd_fit(blocks, labels, lam, nvalid, num_iter: int, widths, mesh):
+def _fused_bcd_fit(x, labels, lam, nvalid, num_iter: int, widths, mesh):
     """The ENTIRE block-least-squares fit as one compiled program.
 
     Centering (label + per-block feature means over the ``nvalid`` true
@@ -115,70 +116,77 @@ def _fused_bcd_fit(blocks, labels, lam, nvalid, num_iter: int, widths, mesh):
     device compute.  The reference's analog is one Spark job per block
     (BlockLinearMapper.scala:147-204); ours is one program per fit.
 
-    blocks: tuple of [N, d_i] arrays; widths: their (static) column counts.
-    Blocks are zero-padded to a common width so the epoch loop is a scan
-    over a stacked [B, N, bs] tensor; pad columns get a unit diagonal shift
-    (their gram rows are zero, so their solutions are exactly zero and the
-    factorization stays positive-definite even at lam=0).
-
-    Memory note (mirrors _fused_bwls_fit): the stacked [B, N, bs] tensor —
-    and the centered copy ``a`` derived from it — transiently adds a full
-    design-matrix footprint while the input blocks are still live (donation
-    cannot alias differently-sized buffers into a stack).  XLA frees the
-    inputs after the stack op; at scales where even the transient matters,
-    lower ``block_size`` so per-block buffers amortize.
+    x: ONE [N, B*bs] design matrix with bs = max(widths); feature block i
+    occupies columns [i*bs, i*bs + widths[i]) and everything else — pad
+    columns of short blocks AND rows at index >= nvalid — must be zero
+    (``fit`` and ``pad_shard_inputs`` guarantee both).  Each scan step
+    dynamic-slices its block out of ``x`` and materializes the centered
+    masked copy of THAT block only, so peak HBM is one design matrix plus a
+    single [N, bs] block — the round-4 form stacked all blocks into a
+    [B, N, bs] tensor plus a centered copy, transiently TRIPLING the
+    design-matrix footprint, which capped the largest fittable solve at a
+    third of HBM.  Pad columns get a unit diagonal shift (their gram rows
+    are zero, so their solutions are exactly zero and the factorization
+    stays positive-definite even at lam=0).
 
     With ``mesh``: rows shard over the data axis (grams lower to local
     MXU gram + ICI all-reduce), models/labels' class columns shard over the
-    model axis — same layout as the round-3 eager path.
+    model axis.
 
     Returns (models [B, bs, k], label_mean [k], means [B, bs]).
     """
     bs = max(widths)
+    nb = len(widths)
     dtype = labels.dtype
     n = labels.shape[0]
 
-    row_spec = col_spec = None
+    col_spec = None
     if mesh is not None:
-        row_spec = NamedSharding(mesh, P(None, DATA_AXIS, None))
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(DATA_AXIS, None))
+        )
         col_spec = NamedSharding(mesh, P(None, None, MODEL_AXIS))
-
-    stacked = jnp.stack(
-        [
-            jnp.pad(blk, ((0, 0), (0, bs - w))) if w < bs else blk
-            for blk, w in zip(blocks, widths)
-        ]
-    )  # [B, N, bs]
-    if row_spec is not None:
-        stacked = jax.lax.with_sharding_constraint(stacked, row_spec)
 
     mask = (jnp.arange(n) < nvalid).astype(dtype)[:, None]
     nv = jnp.asarray(nvalid, dtype)
     label_mean = jnp.sum(labels * mask, axis=0) / nv
     residual = (labels - label_mean) * mask
-    means = jnp.sum(stacked * mask[None], axis=1) / nv  # [B, bs]
-    a = (stacked - means[:, None, :]) * mask[None]
-    if row_spec is not None:
-        a = jax.lax.with_sharding_constraint(a, row_spec)
+    # All block means in one gemv (pad rows are zero by contract).
+    mu = (mask[:, 0] @ x) / nv  # [B*bs]
+    means = mu.reshape(nb, bs)
 
-    # Regularized grams, factored once (they are constant across epochs —
-    # the reference caches them the same way via its gram RDD persist).
-    grams = jnp.einsum("bnd,bne->bde", a, a)
+    def centered_block(i):
+        """(x_block_i - mean_i) * row_mask — the per-step [N, bs] transient
+        (identical numerics to centering the whole matrix, without ever
+        materializing more than one centered block)."""
+        xi = jax.lax.dynamic_slice_in_dim(x, i * bs, bs, axis=1)
+        mu_i = jax.lax.dynamic_slice_in_dim(mu, i * bs, bs, axis=0)
+        return (xi - mu_i) * mask, mu_i
+
     pad_diag = jnp.stack(
         [
             (jnp.arange(bs) >= w).astype(dtype)  # 1.0 on pad columns
             for w in widths
         ]
     )
-    reg = grams + jax.vmap(jnp.diag)(lam + pad_diag)
-    chol = jax.vmap(lambda g: jsl.cho_factor(g)[0])(reg)
 
-    models = jnp.zeros((len(widths), bs, labels.shape[1]), dtype)
+    # Regularized grams, factored once (they are constant across epochs —
+    # the reference caches them the same way via its gram RDD persist).
+    def gram_one(_, inp):
+        i, pd = inp
+        a_i, _ = centered_block(i)
+        reg = a_i.T @ a_i + jnp.diag(lam + pd)
+        return None, jsl.cho_factor(reg)[0]
+
+    _, chol = jax.lax.scan(gram_one, None, (jnp.arange(nb), pad_diag))
+
+    models = jnp.zeros((nb, bs, labels.shape[1]), dtype)
     if col_spec is not None:
         models = jax.lax.with_sharding_constraint(models, col_spec)
 
     def block_step(res, inp):
-        a_i, c_i, m_i = inp
+        i, c_i, m_i = inp
+        a_i, _ = centered_block(i)
         r_i = res + a_i @ m_i
         atb = a_i.T @ r_i  # rows contract over the data axis -> one psum
         m_new = jsl.cho_solve((c_i, False), atb)
@@ -191,7 +199,7 @@ def _fused_bcd_fit(blocks, labels, lam, nvalid, num_iter: int, widths, mesh):
     def epoch(carry, _):
         models, residual = carry
         residual, models = jax.lax.scan(
-            block_step, residual, (a, chol, models)
+            block_step, residual, (jnp.arange(nb), chol, models)
         )
         return (models, residual), None
 
@@ -199,6 +207,39 @@ def _fused_bcd_fit(blocks, labels, lam, nvalid, num_iter: int, widths, mesh):
         epoch, (models, residual), None, length=num_iter
     )
     return models, label_mean, means
+
+
+def _blocked_design_matrix(features, block_size: int, num_features=None):
+    """(x, widths): the [N, B*bs] zero-padded blocked layout _fused_bcd_fit
+    consumes, from either a monolithic [N, d] array or a list of pre-split
+    feature blocks (the reference's fit(Seq[RDD]) form).
+
+    Monolithic input with d a block_size multiple is passed through with NO
+    copy — the common production shape (d = 2·2·descDim·vocabSize etc.) pays
+    zero extra HBM.  Anything needing column padding costs one copy (np.pad
+    host-side for host arrays, so nothing transient lands on device).
+    """
+    if isinstance(features, (list, tuple)):
+        widths = tuple(int(b.shape[1]) for b in features)
+        bs = max(widths)
+        host = not any(isinstance(b, jax.Array) for b in features)
+        xp = np if host else jnp
+        parts = [
+            xp.pad(xp.asarray(b), ((0, 0), (0, bs - w))) if w < bs else xp.asarray(b)
+            for b, w in zip(features, widths)
+        ]
+        return xp.concatenate(parts, axis=1), widths
+    d = num_features or features.shape[1]
+    widths = tuple(
+        min(block_size, d - i) for i in range(0, d, block_size)
+    )
+    bs = max(widths)
+    features = features[:, :d]
+    col_pad = len(widths) * bs - d
+    if col_pad:
+        xp = jnp if isinstance(features, jax.Array) else np
+        features = xp.pad(xp.asarray(features), ((0, 0), (0, col_pad)))
+    return features, widths
 
 
 class BlockLeastSquaresEstimator(LabelEstimator):
@@ -241,16 +282,13 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         BlockLinearMapper.scala:147-204.
         """
         mesh = self.mesh if self.mesh is not None else current_mesh()
-        if isinstance(features, (list, tuple)):
-            blocks = list(features)
-        else:
-            blocks = VectorSplitter(self.block_size, num_features)(features)
+        x, widths = _blocked_design_matrix(
+            features, self.block_size, num_features
+        )
 
         col_pad = 0
         if mesh is not None:
-            (*blocks, labels), nvalid = pad_shard_inputs(
-                mesh, nvalid, *blocks, labels
-            )
+            (x, labels), nvalid = pad_shard_inputs(mesh, nvalid, x, labels)
             # Class columns shard over the model axis; zero label columns
             # stay zero through every BCD update, so the pad is exact.
             m_size = mesh.shape[MODEL_AXIS]
@@ -258,11 +296,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             if col_pad:
                 labels = jnp.pad(labels, ((0, 0), (0, col_pad)))
 
-        widths = tuple(int(b.shape[1]) for b in blocks)
         if nvalid is None:
             nvalid = int(jnp.shape(labels)[0])
         models, label_mean, means = _fused_bcd_fit(
-            tuple(blocks),
+            jnp.asarray(x),
             jnp.asarray(labels),
             jnp.asarray(self.lam, jnp.asarray(labels).dtype),
             nvalid,

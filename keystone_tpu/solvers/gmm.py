@@ -139,8 +139,8 @@ def _em_fit(x, means, variances, weights, var_floor, tol, max_iter: int, chunk: 
     # +/-inf sentinels make the first two conditions unconditionally true,
     # reproducing the eager loop's "first comparison at iteration 2".
     init = (0, means, variances, weights, jnp.inf, -jnp.inf)
-    _, m, v, w, _, _ = jax.lax.while_loop(cond, body, init)
-    return m, v, w
+    iters, m, v, w, _, _ = jax.lax.while_loop(cond, body, init)
+    return m, v, w, iters
 
 
 class GaussianMixtureModelEstimator(Estimator):
@@ -177,8 +177,11 @@ class GaussianMixtureModelEstimator(Estimator):
         weights = jnp.full((self.k,), 1.0 / self.k, x.dtype)
         var_floor = self.var_floor_factor * jnp.mean(global_var)
 
-        means, variances, weights = _em_fit(
+        means, variances, weights, iters = _em_fit(
             x, means, variances, weights, var_floor,
             jnp.asarray(self.tol, x.dtype), self.max_iter, self.chunk,
         )
+        # EM iterations actually run (device-resident until read; a host
+        # pull of this one scalar is the only extra sync a caller pays).
+        self.last_iterations = iters
         return GaussianMixtureModel(means, variances, weights)

@@ -40,9 +40,8 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.pipeline import LabelEstimator
-from ..ops.util import VectorSplitter
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, current_mesh
-from .block import BlockLinearMapper
+from .block import BlockLinearMapper, _blocked_design_matrix
 
 # Per-row byte budget for the column-chunked device gather in the class
 # shuffle: each chunk transiently materializes [p_tot, chunk_bytes] un-sharded
@@ -108,7 +107,7 @@ class _RegroupPlan:
         if self.usable:
             self.send_idx = jnp.asarray(send)
             self.recv_idx = jnp.asarray(recv)
-        self._jitted = {}  # mesh -> compiled regroup (one per fit, reused per block)
+        self._jitted = {}  # mesh -> compiled regroup program
 
     def apply(self, mesh, x):
         """Sorted + zero-tail-padded copy of row-sharded ``x`` via one
@@ -235,7 +234,7 @@ def _class_solves(
     static_argnames=("num_iter", "n_max", "chunk", "num_classes", "widths", "mesh"),
 )
 def _fused_bwls_fit(
-    blocks, labels_sorted, valid, seg_ids, starts, counts, counts_f,
+    x, labels_sorted, valid, seg_ids, starts, counts, counts_f,
     joint_label_mean, nvalid, lam, w,
     num_iter: int, n_max: int, chunk: int, num_classes: int, widths, mesh,
 ):
@@ -248,34 +247,27 @@ def _fused_bwls_fit(
     intercept — round 3 ran ~5 eager dispatches per block per pass over a
     ~126 ms-round-trip transport.  (reference :134-311.)
 
-    blocks: tuple of sorted+padded [P, d_i] arrays; ``widths`` their static
-    column counts.  Blocks zero-pad to a common width; pad columns get a
-    unit diagonal shift on the population covariance (scaled by (1-w) > 0
-    in the joint normal equations), so their solutions are exactly zero and
-    every batched solve stays nonsingular even at lam=0.
-
-    Memory note: the scan-friendly stacked [B, P, bs] tensor transiently
-    doubles the design matrix's footprint while the input blocks are still
-    live (donation cannot alias differently-sized buffers into a stack).
-    XLA frees the inputs after the stack op; at scales where even the
-    transient matters, lower ``block_size`` so per-block buffers amortize.
+    x: ONE sorted, zero-tail-padded [P, B*bs] design matrix (bs =
+    max(widths)); block i occupies columns [i*bs, i*bs + widths[i]) with
+    zero pad columns.  Scan steps dynamic-slice their block out of ``x``,
+    so peak HBM is one design matrix plus a single [P, bs] block slice —
+    the round-4 form stacked blocks into a [B, P, bs] tensor, transiently
+    doubling the footprint.  Pad columns get a unit diagonal shift on the
+    population covariance (scaled by (1-w) > 0 in the joint normal
+    equations), so their solutions are exactly zero and every batched solve
+    stays nonsingular even at lam=0.
 
     Returns (models [B, bs, C], intercept [C]).
     """
     bs = max(widths)
+    nb = len(widths)
     dtype = labels_sorted.dtype
     n = nvalid.astype(dtype)
 
-    stacked = jnp.stack(
-        [
-            jnp.pad(blk, ((0, 0), (0, bs - wd))) if wd < bs else blk
-            for blk, wd in zip(blocks, widths)
-        ]
-    )  # [B, P, bs]
-    row_spec = None
     if mesh is not None:
-        row_spec = NamedSharding(mesh, P(None, DATA_AXIS, None))
-        stacked = jax.lax.with_sharding_constraint(stacked, row_spec)
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(DATA_AXIS, None))
+        )
 
     res = (labels_sorted - joint_label_mean) * valid
     rmean = _residual_class_means(res, seg_ids, counts_f, num_classes)
@@ -284,8 +276,12 @@ def _fused_bwls_fit(
         [(jnp.arange(bs) >= wd).astype(dtype) for wd in widths]
     )  # [B, bs] — 1.0 on pad columns
 
+    def slice_block(i):
+        return jax.lax.dynamic_slice_in_dim(x, i * bs, bs, axis=1)
+
     def stats_one(carry, inp):
-        xb, pd = inp
+        i, pd = inp
+        xb = slice_block(i)
         pop_mean = jnp.sum(xb, axis=0) / n
         pop_cov = xb.T @ xb / n - jnp.outer(pop_mean, pop_mean) + jnp.diag(pd)
         class_means = _class_sums(xb, seg_ids, num_classes) / counts_f[:, None]
@@ -293,14 +289,15 @@ def _fused_bwls_fit(
         return carry, (pop_cov, pop_mean, joint_means)
 
     _, (pop_covs, pop_means, joint_means_all) = jax.lax.scan(
-        stats_one, None, (stacked, pad_diag)
+        stats_one, None, (jnp.arange(nb), pad_diag)
     )
 
-    models = jnp.zeros((len(widths), bs, num_classes), dtype)
+    models = jnp.zeros((nb, bs, num_classes), dtype)
 
     def block_step(carry, inp):
         res, rmean = carry
-        xb, pop_cov, pop_mean, jm, model = inp
+        i, pop_cov, pop_mean, jm, model = inp
+        xb = slice_block(i)
         pop_xtr = xb.T @ res / n
         dw = _class_solves(
             xb, res, starts, counts, pop_cov, pop_mean, pop_xtr,
@@ -316,7 +313,7 @@ def _fused_bwls_fit(
         (res, rmean), models = jax.lax.scan(
             block_step,
             (res, rmean),
-            (stacked, pop_covs, pop_means, joint_means_all, models),
+            (jnp.arange(nb), pop_covs, pop_means, joint_means_all, models),
         )
         return (models, res, rmean), None
 
@@ -406,18 +403,16 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         # Class grouping (the reference's HashPartitioner shuffle +
         # per-partition id sort, :324-361): a host argsort of the [n] class
-        # vector gives the permutation; rows move device-side via one gather
-        # per block below.
+        # vector gives the permutation; rows move device-side via one
+        # regroup of the whole design matrix below.
         order = np.argsort(class_idx, kind="stable")
         starts_np = np.concatenate([[0], np.cumsum(counts_np)[:-1]])
         n_max = int(counts_np.max())
 
-        if isinstance(features, (list, tuple)):
-            blocks = list(features)
-        else:
-            blocks = list(VectorSplitter(self.block_size, num_features)(features))
-
-        dtype = jnp.asarray(blocks[0][:1]).dtype
+        x, widths = _blocked_design_matrix(
+            features, self.block_size, num_features
+        )
+        dtype = jnp.asarray(x[:1, :1]).dtype
         w = self.mixture_weight
 
         # Padded row layout: sorted valid rows, then a zero tail of >= n_max
@@ -495,9 +490,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 outs.append(g)
             return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
 
-        blocks_padded = []
-        while blocks:
-            blocks_padded.append(sort_pad(blocks.pop(0)))
+        x = sort_pad(x)
 
         counts = jnp.asarray(counts_np)
         starts = jnp.asarray(starts_np)
@@ -526,10 +519,10 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             chunk = -(-chunk // m_size) * m_size
 
         # The ENTIRE solve is one compiled program; the dispatches above
-        # (one regroup per block + labels) are the only others in a fit.
-        widths = tuple(int(b.shape[1]) for b in blocks_padded)
+        # (one regroup for the design matrix + one for labels) are the only
+        # others in a fit.
         models_st, b = _fused_bwls_fit(
-            tuple(blocks_padded),
+            x,
             labels_sorted,
             valid.astype(dtype),
             seg_ids,
