@@ -757,6 +757,12 @@ def bench_solve_at_scale(rng):
     if result is None:
         return {"error": "no probed shape fit", "attempts": attempts}
     result["oom_attempts"] = attempts
+    # Release this probe's device buffers (design matrix + labels up to
+    # 16 GB, plus models/means) and drop the executable BEFORE the nested
+    # BWLS bench allocates its own multi-GB matrix — leaving them live
+    # OOMed the nested probe on 16 GB-HBM chips (ADVICE r5).
+    x = y = models = label_mean = means = None  # noqa: F841
+    compiled = lowered = None  # noqa: F841
     result["bwls"] = _guarded(_bench_bwls_at_scale, rng)
     return result
 
@@ -961,53 +967,83 @@ def main():
         if fv.get("flops_per_sec") and peak
         else None
     )
-    print(
-        json.dumps(
-            {
-                "metric": "random_patch_cifar_featurize",
-                "value": value,
-                "unit": "images/sec/chip",
-                "vs_baseline": round(value / prior, 4) if prior else 1.0,
-                "mfu": mfu,
-                "flops_per_sec": cifar["flops_per_sec"],
-                "flops_per_image": cifar["flops_per_image"],
-                "bytes_per_image": cifar["bytes_per_image"],
-                "roofline": roofline(
-                    cifar["flops"], cifar["bytes_accessed"],
-                    cifar["per_iter"],
-                    peak * n_chips if peak else None,
-                    bw * n_chips if bw else None,
-                ),
-                "peak_flops_per_chip": peak,
-                "solve_seconds": round(cifar["solve_seconds"], 4),
-                "solve_examples_per_sec": round(
-                    cifar["solve_examples_per_sec"], 2
-                ),
-                "solve_device_seconds": round(cifar["solve_device_seconds"], 6),
-                "extra_metrics": {
-                    "imagenet_fv_featurize": (
-                        fv
-                        if "error" in fv
-                        else {
-                            "value": round(fv["images_per_sec"] / n_chips, 2),
-                            "unit": "images/sec/chip",
-                            "mfu": fv_mfu,
-                            "flops_per_sec": fv["flops_per_sec"],
-                            "roofline": roofline(
-                                fv["flops"], fv["bytes_accessed"],
-                                fv["per_iter"],
-                                peak * n_chips if peak else None,
-                                bw * n_chips if bw else None,
-                            ),
-                        }
+    record = {
+        "metric": "random_patch_cifar_featurize",
+        "value": value,
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / prior, 4) if prior else 1.0,
+        "mfu": mfu,
+        "flops_per_sec": cifar["flops_per_sec"],
+        "flops_per_image": cifar["flops_per_image"],
+        "bytes_per_image": cifar["bytes_per_image"],
+        "roofline": roofline(
+            cifar["flops"], cifar["bytes_accessed"],
+            cifar["per_iter"],
+            peak * n_chips if peak else None,
+            bw * n_chips if bw else None,
+        ),
+        "peak_flops_per_chip": peak,
+        "solve_seconds": round(cifar["solve_seconds"], 4),
+        "solve_examples_per_sec": round(
+            cifar["solve_examples_per_sec"], 2
+        ),
+        "solve_device_seconds": round(cifar["solve_device_seconds"], 6),
+        "extra_metrics": {
+            "imagenet_fv_featurize": (
+                fv
+                if "error" in fv
+                else {
+                    "value": round(fv["images_per_sec"] / n_chips, 2),
+                    "unit": "images/sec/chip",
+                    "mfu": fv_mfu,
+                    "flops_per_sec": fv["flops_per_sec"],
+                    "roofline": roofline(
+                        fv["flops"], fv["bytes_accessed"],
+                        fv["per_iter"],
+                        peak * n_chips if peak else None,
+                        bw * n_chips if bw else None,
                     ),
-                    "stage_ops": stages,
-                    "solve_at_scale": at_scale,
-                    "jpeg_decode": decode,
-                },
-            }
-        )
+                }
+            ),
+            "stage_ops": stages,
+            "solve_at_scale": at_scale,
+            "jpeg_decode": decode,
+        },
+    }
+    # Artifact-truncation guard (VERDICT r5 "Driver artifacts"): the driver
+    # keeps a bounded TAIL of stdout, and round 5's record — one JSON line
+    # emitted last, after all bench log noise — got cut mid-record
+    # (`parsed: null`, headline number lost).  Emit the machine record
+    # FIRST, flushed, and keep everything after it (the human-readable
+    # summary below) tiny, so any tail window that reaches the end of the
+    # output contains the complete JSON line.
+    print(json.dumps(record), flush=True)
+    ex = record["extra_metrics"]
+    print(
+        f"# {record['metric']}: {value} images/sec/chip "
+        f"(vs_baseline {record['vs_baseline']}, mfu {mfu})"
     )
+    fvx = ex["imagenet_fv_featurize"]
+    print(
+        "# imagenet_fv_featurize: "
+        + (fvx.get("error", "") if "error" in fvx else f"{fvx['value']} images/sec/chip")
+    )
+    sas = ex["solve_at_scale"]
+    if "error" in sas:
+        print(f"# solve_at_scale: {sas['error'][:120]}")
+    else:
+        print(
+            f"# solve_at_scale: n={sas['n']} d={sas['d']} "
+            f"({sas['design_matrix_gb']} GB) in {sas['wall_seconds']} s, "
+            f"{len(sas.get('oom_attempts', []))} OOM attempt(s)"
+        )
+    jd = ex["jpeg_decode"]
+    if "error" not in jd:
+        print(
+            f"# jpeg_decode: serial {jd['serial_images_per_sec']}/s, "
+            f"threaded {jd['threaded_images_per_sec']}/s "
+            f"(x{jd['speedup']})"
+        )
 
 
 if __name__ == "__main__":

@@ -14,6 +14,13 @@ Layer map (SURVEY.md §1 -> here):
   L6  CLI launchers             -> python -m keystone_tpu.workloads.<name>
 """
 
+from .core.checkpoint import (
+    CheckpointError,
+    checkpoint_exists,
+    load_or_fit,
+    load_pipeline,
+    save_pipeline,
+)
 from .core.pipeline import (
     Cacher,
     ChainedEstimator,
@@ -27,6 +34,7 @@ from .core.pipeline import (
     Transformer,
     transformer,
 )
+from .core.resilience import assert_all_finite, retry
 from .parallel.mesh import make_mesh, use_mesh
 
 __version__ = "0.1.0"
@@ -35,6 +43,7 @@ __all__ = [
     "Cacher",
     "ChainedEstimator",
     "ChainedLabelEstimator",
+    "CheckpointError",
     "Estimator",
     "FunctionNode",
     "FunctionTransformer",
@@ -42,7 +51,13 @@ __all__ = [
     "LabelEstimator",
     "Pipeline",
     "Transformer",
+    "assert_all_finite",
+    "checkpoint_exists",
+    "load_or_fit",
+    "load_pipeline",
     "make_mesh",
+    "retry",
+    "save_pipeline",
     "transformer",
     "use_mesh",
 ]

@@ -1,0 +1,394 @@
+"""Checkpoint/restore of fitted pipelines — the load-or-fit pattern,
+generalized (reference GaussianMixtureModel.scala:83-90 loads fitted GMM
+state from CSV flags; SURVEY §5 calls this the artifact-checkpoint idiom).
+
+KeystoneML got fitted-artifact reuse per node via ad-hoc CSV flags and fault
+tolerance from Spark lineage.  Here every node is a registered pytree
+(core.pipeline.register_node), so any fitted node — or a whole ``a >> b``
+pipeline, or a dict/list bundle of them — serializes generically:
+
+* all array leaves land in ONE ``<stem>.npz`` (host numpy arrays; extended
+  dtypes like bfloat16 ride as raw bytes with the true dtype recorded);
+* the tree structure goes to a ``<stem>.json`` manifest: a versioned schema
+  naming each node class (resolved through ``pipeline.NODE_REGISTRY`` on
+  load) plus per-array dtype/shape, validated before any state is touched.
+
+Writes are atomic (tmp file + ``os.replace``) so a preempted save never
+leaves a half-written artifact that a later ``load_or_fit`` would trust.
+
+Public surface:
+  save_pipeline(path, pipe)   -> writes <stem>.npz + <stem>.json
+  load_pipeline(path)         -> rebuilt object (arrays as jax.Arrays)
+  checkpoint_exists(path)     -> bool (both files present)
+  load_or_fit(path, est, *a)  -> load if present, else fit + save
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .pipeline import NODE_REGISTRY, Pipeline
+
+_logger = logging.getLogger("keystone_tpu.checkpoint")
+
+FORMAT_NAME = "keystone-tpu-checkpoint"
+FORMAT_VERSION = 1
+
+# dtypes numpy serializes natively inside an .npz; anything else (bfloat16,
+# fp8, ...) is stored as raw bytes and re-viewed on load.
+_NATIVE_KINDS = frozenset("biufc")
+
+
+class CheckpointError(RuntimeError):
+    """Unserializable node, missing/corrupt artifact, or schema mismatch."""
+
+
+def checkpoint_paths(path: str) -> tuple[str, str]:
+    """``path`` is a stem (``.npz``/``.json`` suffixes are stripped if
+    given); returns (npz_path, manifest_path)."""
+    stem, ext = os.path.splitext(path)
+    if ext not in (".npz", ".json"):
+        stem = path
+    return stem + ".npz", stem + ".json"
+
+
+def checkpoint_exists(path: str) -> bool:
+    npz, manifest = checkpoint_paths(path)
+    return os.path.exists(npz) and os.path.exists(manifest)
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _is_array(v) -> bool:
+    return isinstance(v, (np.ndarray, np.generic, jax.Array))
+
+
+def _dtype_name(v) -> str | None:
+    """Name for a dtype-like meta value (np.dtype, numpy scalar type, or a
+    jnp dtype alias like ``jnp.bfloat16``), else None."""
+    if isinstance(v, np.dtype):
+        return v.name
+    if isinstance(v, type) and issubclass(v, np.generic):
+        return np.dtype(v).name
+    return None
+
+
+class _Encoder:
+    def __init__(self):
+        self.arrays: dict[str, np.ndarray] = {}
+        self.specs: dict[str, dict] = {}
+        self._n = 0
+
+    def add_array(self, v) -> str:
+        key = f"a{self._n}"
+        self._n += 1
+        arr = np.asarray(jax.device_get(v))
+        spec = {"dtype": arr.dtype.name, "shape": list(arr.shape)}
+        if arr.dtype.kind not in _NATIVE_KINDS:
+            # raw-bytes transport for npz-hostile dtypes (e.g. bfloat16)
+            spec["raw"] = True
+            arr = np.frombuffer(arr.tobytes(), np.uint8)
+        self.arrays[key] = arr
+        self.specs[key] = spec
+        return key
+
+    def encode(self, v, where: str) -> dict:
+        if v is None:
+            return {"t": "none"}
+        if isinstance(v, (bool, int, float, str)):
+            return {"t": "py", "v": v}
+        if _is_array(v):
+            return {"t": "arr", "k": self.add_array(v)}
+        dt = _dtype_name(v)
+        if dt is not None:
+            return {"t": "dtype", "v": dt, "as_type": not isinstance(v, np.dtype)}
+        if isinstance(v, (list, tuple)):
+            return {
+                "t": "tuple" if isinstance(v, tuple) else "list",
+                "v": [self.encode(x, f"{where}[{i}]") for i, x in enumerate(v)],
+            }
+        if isinstance(v, dict):
+            if not all(isinstance(k, str) for k in v):
+                raise CheckpointError(f"{where}: dict keys must be strings")
+            return {
+                "t": "dict",
+                "v": {k: self.encode(x, f"{where}[{k!r}]") for k, x in v.items()},
+            }
+        if isinstance(v, Pipeline):
+            return {
+                "t": "pipeline",
+                "nodes": [
+                    self.encode(n, f"{where}.nodes[{i}]")
+                    for i, n in enumerate(v.nodes)
+                ],
+            }
+        # BlockLinearMapper registers its pytree manually (solvers.block),
+        # so it is looked up by name rather than through NODE_REGISTRY.
+        if type(v).__name__ == "BlockLinearMapper":
+            return {
+                "t": "blm",
+                "xs": self.encode(list(v.xs), f"{where}.xs"),
+                "b": self.encode(v.b, f"{where}.b"),
+                "scalers": self.encode(
+                    list(v.feature_scalers), f"{where}.feature_scalers"
+                ),
+                "block_size": int(v.block_size),
+            }
+        entry = NODE_REGISTRY.get(type(v).__name__)
+        if entry is not None and type(v) is entry[0]:
+            _, data_fields, meta_fields = entry
+            return {
+                "t": "node",
+                "cls": type(v).__name__,
+                "data": {
+                    f: self.encode(getattr(v, f), f"{where}.{f}")
+                    for f in data_fields
+                },
+                "meta": {
+                    f: self.encode(getattr(v, f), f"{where}.{f}")
+                    for f in meta_fields
+                },
+            }
+        raise CheckpointError(
+            f"{where}: cannot serialize {type(v).__name__!r} — not a "
+            "registered node (see core.pipeline.register_node) and not a "
+            "plain array/scalar/container.  Function-valued nodes "
+            "(FunctionTransformer, Cacher with a sharding) hold live Python "
+            "objects and are not checkpointable."
+        )
+
+
+def _decode(spec: dict, arrays, array_specs: dict, where: str) -> Any:
+    t = spec.get("t")
+    if t == "none":
+        return None
+    if t == "py":
+        return spec["v"]
+    if t == "arr":
+        key = spec["k"]
+        if key not in arrays:
+            raise CheckpointError(f"{where}: array {key!r} missing from .npz")
+        aspec = array_specs.get(key)
+        if aspec is None:
+            raise CheckpointError(f"{where}: array {key!r} missing from manifest")
+        arr = arrays[key]
+        if aspec.get("raw"):
+            arr = np.frombuffer(arr.tobytes(), np.dtype(aspec["dtype"])).reshape(
+                aspec["shape"]
+            )
+        if arr.dtype.name != aspec["dtype"] or list(arr.shape) != list(
+            aspec["shape"]
+        ):
+            raise CheckpointError(
+                f"{where}: array {key!r} is {arr.dtype.name}{list(arr.shape)}, "
+                f"manifest says {aspec['dtype']}{aspec['shape']} — artifact "
+                "corrupt or schema drift"
+            )
+        return jnp.asarray(arr)
+    if t == "dtype":
+        dt = np.dtype(spec["v"])
+        return dt.type if spec.get("as_type") else dt
+    if t in ("list", "tuple"):
+        vals = [
+            _decode(s, arrays, array_specs, f"{where}[{i}]")
+            for i, s in enumerate(spec["v"])
+        ]
+        return tuple(vals) if t == "tuple" else vals
+    if t == "dict":
+        return {
+            k: _decode(s, arrays, array_specs, f"{where}[{k!r}]")
+            for k, s in spec["v"].items()
+        }
+    if t == "pipeline":
+        return Pipeline(
+            [
+                _decode(s, arrays, array_specs, f"{where}.nodes[{i}]")
+                for i, s in enumerate(spec["nodes"])
+            ]
+        )
+    if t == "blm":
+        from ..solvers.block import BlockLinearMapper
+
+        return BlockLinearMapper(
+            list(_decode(spec["xs"], arrays, array_specs, f"{where}.xs")),
+            int(spec["block_size"]),
+            _decode(spec["b"], arrays, array_specs, f"{where}.b"),
+            list(
+                _decode(spec["scalers"], arrays, array_specs, f"{where}.scalers")
+            ),
+        )
+    if t == "node":
+        name = spec["cls"]
+        entry = NODE_REGISTRY.get(name)
+        if entry is None:
+            raise CheckpointError(
+                f"{where}: node class {name!r} is not registered in this "
+                "process — import the module defining it before loading"
+            )
+        cls, data_fields, meta_fields = entry
+        missing = (set(spec["data"]) ^ set(data_fields)) | (
+            set(spec["meta"]) ^ set(meta_fields)
+        )
+        if missing:
+            raise CheckpointError(
+                f"{where}: field schema of {name!r} changed since this "
+                f"checkpoint was written (mismatched fields: {sorted(missing)})"
+            )
+        # Rebuild exactly the way jax unflattens the pytree: bypass __init__
+        # and set the registered fields (core.pipeline.register_node).
+        obj = object.__new__(cls)
+        for f in data_fields:
+            object.__setattr__(
+                obj, f, _decode(spec["data"][f], arrays, array_specs, f"{where}.{f}")
+            )
+        for f in meta_fields:
+            object.__setattr__(
+                obj, f, _decode(spec["meta"][f], arrays, array_specs, f"{where}.{f}")
+            )
+        return obj
+    raise CheckpointError(f"{where}: unknown manifest entry type {t!r}")
+
+
+def save_pipeline(path: str, pipe) -> str:
+    """Serialize a fitted node / ``Pipeline`` / container of them to
+    ``<stem>.npz`` (array leaves) + ``<stem>.json`` (treedef manifest).
+    Returns the stem.  Atomic: a crash mid-save leaves no partial artifact.
+    """
+    npz_path, manifest_path = checkpoint_paths(path)
+    enc = _Encoder()
+    root = enc.encode(pipe, "root")
+    import hashlib
+    import io
+
+    buf = io.BytesIO()
+    np.savez(buf, **enc.arrays)
+    npz_bytes = buf.getvalue()
+    manifest = {
+        "format": FORMAT_NAME,
+        "version": FORMAT_VERSION,
+        # Ties the pair together: the two files are replaced in separate
+        # atomic renames, so a preemption between them could leave a new
+        # .npz next to an old .json (or vice versa) — the hash check on
+        # load rejects any mixed pair.
+        "npz_sha256": hashlib.sha256(npz_bytes).hexdigest(),
+        "root": root,
+        "arrays": enc.specs,
+    }
+    _atomic_write_bytes(npz_path, npz_bytes)
+    _atomic_write_bytes(
+        manifest_path, json.dumps(manifest, indent=1).encode("utf-8")
+    )
+    _logger.info(
+        "saved checkpoint %s (%d arrays, %.1f KiB)",
+        npz_path,
+        len(enc.arrays),
+        buf.getbuffer().nbytes / 1024,
+    )
+    return os.path.splitext(npz_path)[0]
+
+
+def _ensure_standard_registry() -> None:
+    """Import the library modules that register the stock node classes, so
+    a FRESH process can load a checkpoint without the caller knowing which
+    modules define its nodes.  (Out-of-tree nodes still need their defining
+    module imported by the caller.)"""
+    import importlib
+
+    for mod in (
+        "ops.stats", "ops.util", "ops.images", "ops.fisher", "ops.sift",
+        "ops.lcs", "ops.hog", "ops.daisy", "ops.conv_fused",
+        "solvers.pca", "solvers.gmm", "solvers.linear", "solvers.whitening",
+        "solvers.naive_bayes", "solvers.block",
+    ):
+        try:
+            importlib.import_module(f"keystone_tpu.{mod}")
+        except ImportError as e:  # pragma: no cover - partial installs
+            _logger.warning("registry bootstrap: could not import %s: %s", mod, e)
+
+
+def load_pipeline(path: str):
+    """Rebuild a fitted node/pipeline saved by :func:`save_pipeline`.
+    Validates format version and every array's dtype/shape against the
+    manifest before constructing anything."""
+    _ensure_standard_registry()
+    npz_path, manifest_path = checkpoint_paths(path)
+    try:
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointError(f"cannot read manifest {manifest_path}: {e}") from e
+    if manifest.get("format") != FORMAT_NAME:
+        raise CheckpointError(
+            f"{manifest_path}: not a {FORMAT_NAME} manifest"
+        )
+    if manifest.get("version") != FORMAT_VERSION:
+        raise CheckpointError(
+            f"{manifest_path}: format version {manifest.get('version')} "
+            f"(this build reads {FORMAT_VERSION})"
+        )
+    import hashlib
+    import io
+
+    try:
+        with open(npz_path, "rb") as fh:
+            npz_bytes = fh.read()
+        want_hash = manifest.get("npz_sha256")
+        if want_hash is not None:
+            got_hash = hashlib.sha256(npz_bytes).hexdigest()
+            if got_hash != want_hash:
+                raise CheckpointError(
+                    f"{npz_path}: content hash does not match the manifest — "
+                    "the .npz/.json pair is from two different saves "
+                    "(preempted overwrite?)"
+                )
+        with np.load(io.BytesIO(npz_bytes)) as zf:
+            arrays = {k: zf[k] for k in zf.files}
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"cannot read arrays {npz_path}: {e}") from e
+    extra = set(manifest["arrays"]) - set(arrays)
+    if extra:
+        raise CheckpointError(
+            f"{npz_path}: arrays {sorted(extra)} named in manifest are missing"
+        )
+    obj = _decode(manifest["root"], arrays, manifest["arrays"], "root")
+    _logger.info("loaded checkpoint %s (%d arrays)", npz_path, len(arrays))
+    return obj
+
+
+def load_or_fit(path: str | None, est, *fit_args, save: bool = True, **fit_kwargs):
+    """The GMM/PCA CSV-flag pattern generalized: reload the fitted artifact
+    at ``path`` if present, else fit and (by default) save it there.
+
+    ``est`` is an Estimator/LabelEstimator (``.fit`` is called with the
+    remaining args) or any callable returning the fitted object.  With
+    ``path=None`` this is just the fit."""
+    if path and checkpoint_exists(path):
+        _logger.info("load_or_fit: restoring fitted state from %s", path)
+        return load_pipeline(path)
+    fit = est.fit if hasattr(est, "fit") else est
+    fitted = fit(*fit_args, **fit_kwargs)
+    if path and save:
+        save_pipeline(path, fitted)
+    return fitted

@@ -37,6 +37,11 @@ B = TypeVar("B")
 C = TypeVar("C")
 L = TypeVar("L")
 
+# Class-name -> (cls, data_fields, meta_fields) for every node registered via
+# register_node/@node — the schema the checkpoint serializer (core.checkpoint)
+# walks to save and rebuild fitted pipelines by name.
+NODE_REGISTRY: dict = {}
+
 
 def register_node(cls, data_fields: Sequence[str] = (), meta_fields: Sequence[str] = ()):
     """Register a node class as a JAX pytree.
@@ -47,6 +52,7 @@ def register_node(cls, data_fields: Sequence[str] = (), meta_fields: Sequence[st
     """
     data_fields = tuple(data_fields)
     meta_fields = tuple(meta_fields)
+    NODE_REGISTRY[cls.__name__] = (cls, data_fields, meta_fields)
 
     def flatten(obj):
         return (

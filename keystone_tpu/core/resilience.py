@@ -1,0 +1,217 @@
+"""Fault-tolerance primitives: IO retry with backoff, corrupt-item
+accounting, and finite-state assertions.
+
+KeystoneML inherited fault tolerance from Spark — task retry, lineage
+recompute, and per-record skip counters came with the substrate.  A JAX
+pipeline has no substrate doing that, so the primitives live here:
+
+* :func:`retry` — bounded exponential-backoff retry for transient IO
+  (tar/file reads, the native decoder's one-time g++ build).  Tunable via
+  ``KEYSTONE_IO_RETRIES`` / ``KEYSTONE_IO_BACKOFF`` / ``KEYSTONE_IO_TIMEOUT``.
+* :class:`FaultCounters` / module singleton :data:`counters` — named counts
+  of survived faults (corrupt images, unreadable tar members, retried
+  opens), logged through the ``keystone_tpu`` logger hierarchy
+  (core.logging) instead of being silently dropped.
+* :func:`assert_all_finite` — the fit-path guard: every float leaf of a
+  fitted model pytree must be finite, else the fit fails loudly instead of
+  serving NaN predictions.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import threading
+import time
+from typing import Callable
+
+import jax
+import numpy as np
+
+_logger = logging.getLogger("keystone_tpu.resilience")
+
+# Exception types treated as transient by default: filesystem hiccups,
+# truncated reads, interrupted syscalls.  (tarfile raises tarfile.TarError
+# subclasses for corrupt archives — those are *data* faults, counted and
+# skipped by the loaders, not retried.)
+DEFAULT_RETRY_ON: tuple[type[BaseException], ...] = (OSError, EOFError)
+
+# OSError subclasses that can never succeed on retry — a typo'd path or a
+# permissions problem should fail fast, not sleep through the backoff
+# schedule logging misleading io_retry warnings.
+PERMANENT_ERRORS: tuple[type[BaseException], ...] = (
+    FileNotFoundError,
+    IsADirectoryError,
+    NotADirectoryError,
+    PermissionError,
+)
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
+    if val < 1:
+        raise ValueError(f"{name}={raw!r} must be >= 1")
+    return val
+
+
+def _env_float(name: str, default: float | None) -> float | None:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not a number") from None
+
+
+def retry(
+    fn: Callable | None = None,
+    *,
+    attempts: int | None = None,
+    backoff: float | None = None,
+    timeout: float | None = None,
+    retry_on: tuple[type[BaseException], ...] = DEFAULT_RETRY_ON,
+    name: str | None = None,
+):
+    """Wrap ``fn`` with bounded retry + exponential backoff.
+
+    ``attempts``: total tries (default ``KEYSTONE_IO_RETRIES`` or 3).
+    ``backoff``: first sleep in seconds, doubling per retry (default
+    ``KEYSTONE_IO_BACKOFF`` or 0.1).
+    ``timeout``: total wall-clock budget across attempts (default
+    ``KEYSTONE_IO_TIMEOUT`` or unlimited) — when exceeded, the last error
+    is raised instead of sleeping again.
+    ``retry_on``: exception types considered transient; anything else —
+    including the :data:`PERMANENT_ERRORS` subclasses (missing paths,
+    permissions) — propagates immediately.
+
+    Usable as a decorator (``@retry``/``@retry(attempts=5)``) or inline
+    (``retry(tarfile.open)(path)``).  Every retried failure is logged and
+    counted under ``io_retry``.
+    """
+    if fn is None:
+        return functools.partial(
+            retry,
+            attempts=attempts,
+            backoff=backoff,
+            timeout=timeout,
+            retry_on=retry_on,
+            name=name,
+        )
+
+    label = name or getattr(fn, "__name__", "fn")
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        n = attempts if attempts is not None else _env_int("KEYSTONE_IO_RETRIES", 3)
+        pause = (
+            backoff
+            if backoff is not None
+            else (_env_float("KEYSTONE_IO_BACKOFF", 0.1) or 0.0)
+        )
+        budget = (
+            timeout if timeout is not None else _env_float("KEYSTONE_IO_TIMEOUT", None)
+        )
+        t0 = time.monotonic()
+        for attempt in range(1, n + 1):
+            try:
+                return fn(*args, **kwargs)
+            except retry_on as e:
+                if isinstance(e, PERMANENT_ERRORS):
+                    raise  # user error, not a transient fault
+                out_of_budget = (
+                    budget is not None and time.monotonic() - t0 + pause > budget
+                )
+                if attempt >= n or out_of_budget:
+                    _logger.error(
+                        "%s failed after %d attempt(s)%s: %s",
+                        label,
+                        attempt,
+                        " (timeout budget exhausted)" if out_of_budget else "",
+                        e,
+                    )
+                    raise
+                counters.record(
+                    "io_retry", f"{label} attempt {attempt}/{n}: {e}"
+                )
+                time.sleep(pause)
+                pause *= 2.0
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    return wrapped
+
+
+class FaultCounters:
+    """Thread-safe named counters for survived faults.
+
+    Loaders and solvers call :meth:`record`; each event is logged (WARNING)
+    through the keystone_tpu logger tree so operators see skips as they
+    happen, and the totals are queryable (:meth:`counts`) so pipelines and
+    tests can assert "N items skipped" instead of guessing from log grep.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def record(self, kind: str, detail: str | None = None) -> int:
+        with self._lock:
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            total = self._counts[kind]
+        _logger.warning(
+            "%s #%d%s", kind, total, f": {detail}" if detail else ""
+        )
+        return total
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def get(self, kind: str) -> int:
+        with self._lock:
+            return self._counts.get(kind, 0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+#: Process-wide fault ledger (loaders/image_loaders, loaders/native_decode).
+counters = FaultCounters()
+
+
+def numerics_guard_enabled() -> bool:
+    """Non-finite checks + Cholesky jitter-retry are on unless
+    ``KEYSTONE_NUMERICS_GUARD=0`` (the checks cost one host sync per
+    guarded solve)."""
+    return os.environ.get("KEYSTONE_NUMERICS_GUARD", "").strip() != "0"
+
+
+def assert_all_finite(tree, name: str = "fitted model"):
+    """Raise ``FloatingPointError`` if any inexact-dtype array leaf of
+    ``tree`` contains NaN/Inf.  Returns ``tree`` so fit paths can guard
+    inline: ``model = assert_all_finite(est.fit(x, y), "block solve")``."""
+    bad = []
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+        if not isinstance(leaf, (np.ndarray, np.generic, jax.Array)):
+            continue
+        dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+        if dtype.kind not in "fc":
+            continue
+        finite = np.isfinite(np.asarray(jax.device_get(leaf), np.float64)).all()
+        if not finite:
+            bad.append(i)
+    if bad:
+        raise FloatingPointError(
+            f"{name} contains non-finite values in {len(bad)} leaf/leaves "
+            f"(indices {bad}) — refusing to ship a silently-broken model "
+            "(ill-conditioned solve, NaN input batch, or overflow upstream)"
+        )
+    return tree

@@ -24,6 +24,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..core.resilience import counters, retry
+
 # Extra decode-ahead slots beyond the pool width.  The in-order window holds
 # DECODED f32 images (~12x the JPEG bytes), so it must cover decode latency
 # without scaling multiplicatively with cores: threads + _DECODE_AHEAD total
@@ -105,16 +107,39 @@ def _tar_files(path: str) -> list[str]:
 
 
 def _iter_tar_members(path: str):
-    """Yield (member_name, raw_bytes) for each file entry in the tar(s)."""
+    """Yield (member_name, raw_bytes) for each file entry in the tar(s).
+
+    Fault behavior (the reference gets per-record skip + task retry from
+    Spark; here it is explicit): opening each tar retries transient IO
+    errors with backoff (core.resilience.retry); a member whose payload
+    cannot be read (truncated/corrupt entry) is counted under
+    ``tar_member_error`` and skipped; a corrupt member *header* ends that
+    tar (tar framing is unrecoverable past it) with a counted
+    ``tar_stream_error`` but does not abort the remaining tars."""
     for tar_path in _tar_files(path):
-        with tarfile.open(tar_path) as tf:
-            for member in tf:
+        with retry(tarfile.open, name=f"tarfile.open({tar_path})")(tar_path) as tf:
+            it = iter(tf)
+            while True:
+                try:
+                    member = next(it)
+                except StopIteration:
+                    break
+                except (tarfile.TarError, OSError, EOFError) as e:
+                    counters.record("tar_stream_error", f"{tar_path}: {e}")
+                    break
                 if not member.isfile():
                     continue
-                f = tf.extractfile(member)
-                if f is None:
+                try:
+                    f = tf.extractfile(member)
+                    if f is None:
+                        continue
+                    data = f.read()
+                except (tarfile.TarError, OSError, EOFError) as e:
+                    counters.record(
+                        "tar_member_error", f"{tar_path}:{member.name}: {e}"
+                    )
                     continue
-                yield member.name.lstrip("./"), f.read()
+                yield member.name.lstrip("./"), data
 
 
 def decode_threads() -> int:
@@ -160,6 +185,8 @@ def _iter_tar_images(path: str, num_threads: int | None = None):
             img = decode_image(data)
             if img is not None:
                 yield name, img
+            else:
+                counters.record("corrupt_image", name)
         return
 
     with ThreadPoolExecutor(max_workers=num_threads) as pool:
@@ -171,11 +198,15 @@ def _iter_tar_images(path: str, num_threads: int | None = None):
                 img = fut.result()
                 if img is not None:
                     yield done_name, img
+                else:
+                    counters.record("corrupt_image", done_name)
         while window:
             done_name, fut = window.popleft()
             img = fut.result()
             if img is not None:
                 yield done_name, img
+            else:
+                counters.record("corrupt_image", done_name)
 
 
 def voc_loader(data_path: str, labels_path: str, name_prefix: str = "VOCdevkit/VOC2007/JPEGImages/") -> MultiLabeledImages:
@@ -183,7 +214,7 @@ def voc_loader(data_path: str, labels_path: str, name_prefix: str = "VOCdevkit/V
     columns (id, class, classname, traintesteval, filename); class ids are
     1-indexed in the file."""
     labels_map: dict[str, list[int]] = {}
-    with open(labels_path) as fh:
+    with retry(open, name=f"open({labels_path})")(labels_path) as fh:
         next(fh, None)  # header (empty file -> no rows)
         for line in fh:
             if not line.strip():
@@ -210,7 +241,7 @@ def imagenet_loader(data_path: str, labels_path: str) -> LabeledImages:
     one synset directory whose name maps to a class id via the
     space-separated labels file."""
     labels_map: dict[str, int] = {}
-    with open(labels_path) as fh:
+    with retry(open, name=f"open({labels_path})")(labels_path) as fh:
         for line in fh:
             parts = line.split()
             if len(parts) >= 2:

@@ -31,11 +31,23 @@ _tried = False
 
 
 def _build() -> bool:
+    from ..core.resilience import retry
+
     cmd = [
         "g++", "-O2", "-shared", "-fPIC", _SRC, "-o", _LIB, "-ljpeg",
     ]
+
+    # The one-time g++ invocation is plain file IO + a subprocess — fork
+    # failures and filesystem hiccups on busy hosts are transient, so the
+    # build retries with backoff before the loader settles for PIL.  A
+    # compile that blows the 120 s timeout is NOT transient (each retry
+    # would stall startup another two minutes): it fails straight to PIL.
+    @retry(retry_on=(OSError,), name="native_decode_build")
+    def _run():
+        return subprocess.run(cmd, capture_output=True, timeout=120)
+
     try:
-        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        res = _run()
     except (OSError, subprocess.TimeoutExpired):
         return False
     return res.returncode == 0 and os.path.exists(_LIB)

@@ -11,14 +11,23 @@ on each data shard hit the MXU, one psum over ICI reduces them, and the
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core.resilience import numerics_guard_enabled
 from ..parallel.collectives import sharded_gram
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, padded_shard_rows
+
+_logger = logging.getLogger("keystone_tpu.solvers.normal_equations")
+
+# Jitter-retry escalation depth: regularizer grows λ·10^k for k=1..3 before
+# the solve gives up (reference ml-matrix has no recovery at all — a
+# rank-deficient gram NaNs the model silently).
+_MAX_JITTER_ESCALATIONS = 3
 
 
 @jax.jit
@@ -35,8 +44,66 @@ def _solve_gram_l2(ata, atb, lam):
     return jsl.cho_solve((c, low), atb)
 
 
-solve_gram_l2 = jax.jit(_solve_gram_l2)
-solve_gram_l2.__doc__ = "Solve ``(AᵀA + λI) X = AᵀB`` via Cholesky."
+_solve_gram_l2_jit = jax.jit(_solve_gram_l2)
+
+
+def _all_finite(x) -> bool:
+    return bool(jnp.all(jnp.isfinite(x)))
+
+
+def _guarded_solve(solve_fn, ata, atb, lam):
+    """Run ``solve_fn(ata, atb, lam)`` with non-finite input checks and
+    Cholesky jitter-retry: an indefinite/rank-deficient gram NaNs the f32
+    Cholesky, so the regularizer escalates λ·10^k (k ≤ 3, each step logged)
+    before erroring.  λ=0 escalates from a floor of ~f32-eps times the mean
+    gram diagonal, the standard relative-jitter scale.
+
+    The checks cost one host sync per solve; ``KEYSTONE_NUMERICS_GUARD=0``
+    restores the unguarded single-dispatch path.
+    """
+    lam_arr = jnp.asarray(lam, ata.dtype)
+    if not numerics_guard_enabled():
+        return solve_fn(ata, atb, lam_arr)
+    if not _all_finite(ata) or not _all_finite(atb):
+        raise FloatingPointError(
+            "solve_gram_l2: non-finite entries in the gram/right-hand side "
+            "— a NaN/Inf batch reached the solver (inject upstream guards, "
+            "see core.resilience)"
+        )
+    x = solve_fn(ata, atb, lam_arr)
+    if _all_finite(x):
+        return x
+    lam0 = float(lam)
+    base = lam0
+    if base <= 0.0:
+        mean_diag = float(jnp.mean(jnp.diagonal(ata)))
+        base = 1.2e-7 * abs(mean_diag) if mean_diag != 0.0 else 1.2e-7
+    for k in range(1, _MAX_JITTER_ESCALATIONS + 1):
+        lam_k = base * (10.0 ** k)
+        _logger.warning(
+            "solve_gram_l2: Cholesky produced non-finite solution at "
+            "lam=%.3g; retrying with jitter lam=%.3g (escalation %d/%d)",
+            lam0 if k == 1 else base * (10.0 ** (k - 1)),
+            lam_k,
+            k,
+            _MAX_JITTER_ESCALATIONS,
+        )
+        x = solve_fn(ata, atb, jnp.asarray(lam_k, ata.dtype))
+        if _all_finite(x):
+            return x
+    raise FloatingPointError(
+        f"solve_gram_l2: solution still non-finite after "
+        f"{_MAX_JITTER_ESCALATIONS} jitter escalations "
+        f"(final lam={base * 10.0 ** _MAX_JITTER_ESCALATIONS:.3g}) — the "
+        "gram is numerically broken beyond regularization"
+    )
+
+
+def solve_gram_l2(ata, atb, lam):
+    """Solve ``(AᵀA + λI) X = AᵀB`` via Cholesky, guarded: non-finite
+    inputs raise, and a failed factorization retries with escalating
+    jitter (λ·10^k, k ≤ 3, logged) before erroring."""
+    return _guarded_solve(_solve_gram_l2_jit, ata, atb, lam)
 
 
 @functools.lru_cache(maxsize=None)
@@ -86,7 +153,7 @@ def solve_least_squares(a, b, lam: float = 0.0, mesh=None):
     b, _ = padded_shard_rows(b, mesh)
     b, col_pad = _pad_cols(b, mesh.shape[MODEL_AXIS])
     ata, atb = sharded_gram(mesh, a, b)
-    x = solve(ata, atb, jnp.asarray(lam, ata.dtype))
+    x = _guarded_solve(solve, ata, atb, lam)
     return x[:, : x.shape[1] - col_pad] if col_pad else x
 
 

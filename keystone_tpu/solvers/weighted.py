@@ -36,7 +36,11 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.5 jax exports it under experimental only
+    from jax.experimental.shard_map import shard_map
+
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.pipeline import LabelEstimator

@@ -19,7 +19,9 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
 from ..core.logging import Logging, configure_logging
+from ..core.resilience import assert_all_finite
 from ..loaders.image_loaders import LabeledImages, imagenet_loader
 from ..ops.lcs import LCSExtractor
 from ..ops.sift import SIFTExtractor
@@ -70,6 +72,9 @@ class ImageNetSiftLcsFVConfig:
     num_gmm_samples: int = int(1e7)
     num_classes: int = 1000
     seed: int = 42
+    # Whole-fitted-pipeline checkpoint stem (core.checkpoint): both
+    # branches' PCA + GMM plus the weighted block solve in one artifact.
+    pipeline_file: str | None = None
 
 
 class _Log(Logging):
@@ -81,7 +86,7 @@ def _fit_branch(conf: ImageNetSiftLcsFVConfig, desc_buckets: dict, pca_file, gmm
     the reference fits once and applies the same featurizer to test
     (ImageNetSiftLcsFV.scala:69,91,145).
 
-    Returns (batch_pca, fisher, train_pca_desc): the PCA-projected train
+    Returns (batch_pca, gmm, train_pca_desc): the PCA-projected train
     buckets are returned so callers never re-project the training set."""
     if pca_file is not None:
         pca_mat = jnp.asarray(
@@ -108,8 +113,9 @@ def _fit_branch(conf: ImageNetSiftLcsFVConfig, desc_buckets: dict, pca_file, gmm
         if gmm_samples.shape[1] > GMM_FIT_CAP:
             gmm_samples = gmm_samples[:, :GMM_FIT_CAP]
         gmm = GaussianMixtureModelEstimator(conf.vocab_size).fit(gmm_samples.T)
+    assert_all_finite(gmm, "branch GMM fit")
 
-    return batch_pca, fisher_feature_pipeline(gmm), pca_desc
+    return batch_pca, gmm, pca_desc
 
 
 def sift_descriptor_buckets(
@@ -152,11 +158,13 @@ def branch_features(
     seed: int,
     mesh=None,
 ):
-    """Fit transformers on train, apply to train AND test."""
+    """Fit transformers on train, apply to train AND test.  Returns the
+    fitted (batch_pca, gmm) too so callers can checkpoint the branch."""
     train_desc = descriptor_fn(conf, train_images, mesh)
-    batch_pca, fisher, train_pca_desc = _fit_branch(
+    batch_pca, gmm, train_pca_desc = _fit_branch(
         conf, train_desc, pca_file, gmm_files, seed
     )
+    fisher = fisher_feature_pipeline(gmm)
     feat_dim = 2 * conf.desc_dim * conf.vocab_size
     train_feats = scatter_features(
         train_pca_desc, fisher, len(train_images), feat_dim
@@ -165,7 +173,25 @@ def branch_features(
     test_feats = scatter_features(
         test_desc, lambda d: fisher(batch_pca(d)), len(test_images), feat_dim
     )
-    return train_feats, test_feats
+    return train_feats, test_feats, batch_pca, gmm
+
+
+def branch_test_features(
+    conf: ImageNetSiftLcsFVConfig,
+    test_images: list,
+    descriptor_fn,
+    batch_pca,
+    gmm,
+    mesh=None,
+):
+    """Apply an already-fitted branch (restored from a checkpoint) to test
+    images only — the reload half of load-or-fit."""
+    fisher = fisher_feature_pipeline(gmm)
+    feat_dim = 2 * conf.desc_dim * conf.vocab_size
+    test_desc = descriptor_fn(conf, test_images, mesh)
+    return scatter_features(
+        test_desc, lambda d: fisher(batch_pca(d)), len(test_images), feat_dim
+    )
 
 
 def run(
@@ -183,37 +209,69 @@ def run(
     log = _Log()
     t0 = time.perf_counter()
 
-    train_sift, test_sift = branch_features(
-        conf,
-        train.images,
-        test.images,
-        sift_descriptor_buckets,
-        conf.sift_pca_file,
-        (conf.sift_gmm_mean_file, conf.sift_gmm_var_file, conf.sift_gmm_wts_file),
-        conf.seed,
-        mesh,
-    )
-    train_lcs, test_lcs = branch_features(
-        conf,
-        train.images,
-        test.images,
-        lcs_descriptor_buckets,
-        conf.lcs_pca_file,
-        (conf.lcs_gmm_mean_file, conf.lcs_gmm_var_file, conf.lcs_gmm_wts_file),
-        conf.seed + 100,
-        mesh,
-    )
+    if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
+        # Load-or-fit of the whole fitted pipeline: skip training
+        # featurization and every fit; score test with restored state.
+        log.log_info("restoring fitted pipeline from %s", conf.pipeline_file)
+        ck = load_pipeline(conf.pipeline_file)
+        test_sift = branch_test_features(
+            conf, test.images, sift_descriptor_buckets,
+            ck["sift_pca"], ck["sift_gmm"], mesh,
+        )
+        test_lcs = branch_test_features(
+            conf, test.images, lcs_descriptor_buckets,
+            ck["lcs_pca"], ck["lcs_gmm"], mesh,
+        )
+        model = ck["model"]
+        test_features = jnp.asarray(
+            np.concatenate([test_sift, test_lcs], axis=1)
+        )
+    else:
+        train_sift, test_sift, sift_pca, sift_gmm = branch_features(
+            conf,
+            train.images,
+            test.images,
+            sift_descriptor_buckets,
+            conf.sift_pca_file,
+            (conf.sift_gmm_mean_file, conf.sift_gmm_var_file, conf.sift_gmm_wts_file),
+            conf.seed,
+            mesh,
+        )
+        train_lcs, test_lcs, lcs_pca, lcs_gmm = branch_features(
+            conf,
+            train.images,
+            test.images,
+            lcs_descriptor_buckets,
+            conf.lcs_pca_file,
+            (conf.lcs_gmm_mean_file, conf.lcs_gmm_var_file, conf.lcs_gmm_wts_file),
+            conf.seed + 100,
+            mesh,
+        )
 
-    # ZipVectors (:179-183) — kept host-side; the solver shards its blocks
-    train_features = np.concatenate([train_sift, train_lcs], axis=1)
-    test_features = jnp.asarray(np.concatenate([test_sift, test_lcs], axis=1))
+        # ZipVectors (:179-183) — kept host-side; the solver shards its blocks
+        train_features = np.concatenate([train_sift, train_lcs], axis=1)
+        test_features = jnp.asarray(np.concatenate([test_sift, test_lcs], axis=1))
 
-    labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
+        labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
 
-    # 2·2·descDim·vocabSize features (:186-188)
-    model = BlockWeightedLeastSquaresEstimator(
-        4096, 1, conf.lam, conf.mixture_weight, mesh=mesh
-    ).fit(train_features, labels, num_features=2 * 2 * conf.desc_dim * conf.vocab_size)
+        # 2·2·descDim·vocabSize features (:186-188)
+        model = BlockWeightedLeastSquaresEstimator(
+            4096, 1, conf.lam, conf.mixture_weight, mesh=mesh
+        ).fit(train_features, labels, num_features=2 * 2 * conf.desc_dim * conf.vocab_size)
+        assert_all_finite(model, "ImageNet weighted block solve")
+
+        if conf.pipeline_file is not None:
+            save_pipeline(
+                conf.pipeline_file,
+                {
+                    "sift_pca": sift_pca,
+                    "sift_gmm": sift_gmm,
+                    "lcs_pca": lcs_pca,
+                    "lcs_gmm": lcs_gmm,
+                    "model": model,
+                },
+            )
+            log.log_info("saved fitted pipeline to %s", conf.pipeline_file)
 
     test_scores = model(test_features)
     k = min(5, conf.num_classes)
@@ -244,6 +302,12 @@ def main(argv=None):
     p.add_argument("--numPcaSamples", type=int, default=int(1e7))
     p.add_argument("--numGmmSamples", type=int, default=int(1e7))
     p.add_argument("--numClasses", type=int, default=1000)
+    p.add_argument(
+        "--pipelineFile",
+        default=None,
+        help="fitted-pipeline checkpoint stem: load-or-fit of both branches' "
+        "PCA+GMM and the weighted solve",
+    )
     p.add_argument(
         "--mesh",
         default=None,
@@ -278,8 +342,14 @@ def main(argv=None):
         num_pca_samples=a.numPcaSamples,
         num_gmm_samples=a.numGmmSamples,
         num_classes=a.numClasses,
+        pipeline_file=a.pipelineFile,
     )
-    train = imagenet_loader(conf.train_location, conf.label_path)
+    if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
+        # Restored runs never touch training data — skip decoding the
+        # entire training tar set (the dominant reload-path cost).
+        train = LabeledImages([], np.zeros(0, np.int32), [])
+    else:
+        train = imagenet_loader(conf.train_location, conf.label_path)
     test = imagenet_loader(conf.test_location, conf.label_path)
     return run(conf, train, test, mesh=parse_mesh(a.mesh))
 

@@ -12,13 +12,16 @@ checkpoint pattern (SURVEY §5).
 from __future__ import annotations
 
 import argparse
+import os
 import time
 from dataclasses import dataclass
 
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
 from ..core.logging import Logging, configure_logging
+from ..core.resilience import assert_all_finite
 from ..evaluation.map import MeanAveragePrecisionEvaluator
 from ..loaders.image_loaders import VOC_NUM_CLASSES, MultiLabeledImages, voc_loader
 from ..ops.sift import SIFTExtractor
@@ -56,6 +59,13 @@ class SIFTFisherConfig:
     num_gmm_samples: int = int(1e6)
     sift_step_size: int = 3
     seed: int = 42
+    # Whole-fitted-pipeline checkpoint stem (core.checkpoint): load-or-fit of
+    # PCA + GMM + linear model in one artifact — the generalization of the
+    # per-node pcaFile/gmm*File CSV flags.
+    pipeline_file: str | None = None
+    # Resumable-solve state path: the BCD fit checkpoints after every block
+    # and restarts from the last completed block if the state file exists.
+    solve_checkpoint: str | None = None
 
 
 class _Log(Logging):
@@ -97,46 +107,81 @@ def run(
     log = _Log()
     t0 = time.perf_counter()
 
-    label_node = ClassLabelIndicatorsFromIntArrayLabels(VOC_NUM_CLASSES)
-    train_labels = label_node(train.labels)
-
-    # Part 1+2: SIFT descriptors per shape bucket (reference :36-57)
-    train_desc = extract_sift_buckets(conf, train.images, mesh)
-
-    # Part 1a: PCA — fit on sampled descriptor columns, or load (:40-50)
-    if conf.pca_file is not None:
-        pca_mat = jnp.asarray(
-            np.loadtxt(conf.pca_file, delimiter=",", ndmin=2).T, jnp.float32
-        )
-    else:
-        samples = sample_columns(train_desc, conf.num_pca_samples, conf.seed)
-        pca_mat = compute_pca(samples.T, conf.desc_dim)
-    batch_pca = BatchPCATransformer(pca_mat)
-
-    pca_desc = {
-        shape: (idx, batch_pca(descs)) for shape, (idx, descs) in train_desc.items()
-    }
-
-    # Part 2a: GMM — fit on sampled PCA'd columns, or load (:59-70)
-    if conf.gmm_mean_file is not None:
-        gmm = GaussianMixtureModel.load(
-            conf.gmm_mean_file, conf.gmm_var_file, conf.gmm_wts_file
-        )
-    else:
-        gmm_samples = sample_columns(pca_desc, conf.num_gmm_samples, conf.seed + 1)
-        gmm = GaussianMixtureModelEstimator(conf.vocab_size).fit(gmm_samples.T)
-
-    # Part 3: Fisher features (:72-82)
-    fisher = fisher_feature_pipeline(gmm)
     feat_dim = 2 * conf.desc_dim * conf.vocab_size
-    train_features = jnp.asarray(
-        scatter_features(pca_desc, fisher, len(train), feat_dim)
-    )
 
-    # Part 4: linear model (:84-86) — mesh-distributed when given one
-    model = BlockLeastSquaresEstimator(4096, 1, conf.lam, mesh=mesh).fit(
-        train_features, train_labels, num_features=feat_dim
-    )
+    # Load-or-fit of the WHOLE fitted pipeline (SURVEY §5 generalized): when
+    # the checkpoint exists, training featurization and all fits are skipped
+    # and the run scores test data with the restored PCA + GMM + model.
+    if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
+        log.log_info("restoring fitted pipeline from %s", conf.pipeline_file)
+        ck = load_pipeline(conf.pipeline_file)
+        batch_pca, gmm, model = ck["pca"], ck["gmm"], ck["model"]
+        fisher = fisher_feature_pipeline(gmm)
+    else:
+        label_node = ClassLabelIndicatorsFromIntArrayLabels(VOC_NUM_CLASSES)
+        train_labels = label_node(train.labels)
+
+        # Part 1+2: SIFT descriptors per shape bucket (reference :36-57)
+        train_desc = extract_sift_buckets(conf, train.images, mesh)
+
+        # Part 1a: PCA — fit on sampled descriptor columns, or load (:40-50)
+        if conf.pca_file is not None:
+            pca_mat = jnp.asarray(
+                np.loadtxt(conf.pca_file, delimiter=",", ndmin=2).T, jnp.float32
+            )
+        else:
+            samples = sample_columns(train_desc, conf.num_pca_samples, conf.seed)
+            pca_mat = compute_pca(samples.T, conf.desc_dim)
+        batch_pca = BatchPCATransformer(pca_mat)
+
+        pca_desc = {
+            shape: (idx, batch_pca(descs)) for shape, (idx, descs) in train_desc.items()
+        }
+
+        # Part 2a: GMM — fit on sampled PCA'd columns, or load (:59-70)
+        if conf.gmm_mean_file is not None:
+            gmm = GaussianMixtureModel.load(
+                conf.gmm_mean_file, conf.gmm_var_file, conf.gmm_wts_file
+            )
+        else:
+            gmm_samples = sample_columns(pca_desc, conf.num_gmm_samples, conf.seed + 1)
+            gmm = GaussianMixtureModelEstimator(conf.vocab_size).fit(gmm_samples.T)
+        assert_all_finite(gmm, "VOC GMM fit")
+
+        # Part 3: Fisher features (:72-82)
+        fisher = fisher_feature_pipeline(gmm)
+        train_features = jnp.asarray(
+            scatter_features(pca_desc, fisher, len(train), feat_dim)
+        )
+
+        # Part 4: linear model (:84-86) — mesh-distributed when given one;
+        # with a solve checkpoint the BCD fit persists per-block state and
+        # resumes from it after preemption.
+        solve_kwargs = {}
+        state_path = None
+        if conf.solve_checkpoint is not None:
+            from ..solvers.block import bcd_checkpoint_path
+
+            solve_kwargs["checkpoint"] = conf.solve_checkpoint
+            state_path = bcd_checkpoint_path(conf.solve_checkpoint)
+            if os.path.exists(state_path):
+                solve_kwargs["resume_from"] = conf.solve_checkpoint
+        model = BlockLeastSquaresEstimator(4096, 1, conf.lam, mesh=mesh).fit(
+            train_features, train_labels, num_features=feat_dim, **solve_kwargs
+        )
+        assert_all_finite(model, "VOC block least-squares fit")
+        if state_path is not None and os.path.exists(state_path):
+            # The per-block state is a RESUME artifact, not a model cache:
+            # leaving the completed state behind would make a later rerun
+            # with different features silently resume into the stale model.
+            os.unlink(state_path)
+
+        if conf.pipeline_file is not None:
+            save_pipeline(
+                conf.pipeline_file,
+                {"pca": batch_pca, "gmm": gmm, "model": model},
+            )
+            log.log_info("saved fitted pipeline to %s", conf.pipeline_file)
 
     # Test path (:92-106)
     test_desc = extract_sift_buckets(conf, test.images, mesh)
@@ -172,6 +217,16 @@ def main(argv=None):
     p.add_argument("--numPcaSamples", type=int, default=int(1e6))
     p.add_argument("--numGmmSamples", type=int, default=int(1e6))
     p.add_argument(
+        "--pipelineFile",
+        default=None,
+        help="fitted-pipeline checkpoint stem: load-or-fit of PCA+GMM+model",
+    )
+    p.add_argument(
+        "--solveCheckpoint",
+        default=None,
+        help="resumable BCD state path: per-block checkpoint + auto-resume",
+    )
+    p.add_argument(
         "--mesh",
         default=None,
         help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
@@ -191,8 +246,15 @@ def main(argv=None):
         gmm_wts_file=a.gmmWtsFile,
         num_pca_samples=a.numPcaSamples,
         num_gmm_samples=a.numGmmSamples,
+        pipeline_file=a.pipelineFile,
+        solve_checkpoint=a.solveCheckpoint,
     )
-    train = voc_loader(conf.train_location, conf.label_path)
+    if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
+        # Restored runs never touch training data — skip decoding the
+        # entire training tar (the dominant reload-path cost).
+        train = MultiLabeledImages([], [], [])
+    else:
+        train = voc_loader(conf.train_location, conf.label_path)
     test = voc_loader(conf.test_location, conf.label_path)
     return run(conf, train, test, mesh=parse_mesh(a.mesh))
 

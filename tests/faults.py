@@ -1,0 +1,136 @@
+"""Fault-injection harness for the resilience suite (NOT a test module —
+imported by tests/test_resilience.py and usable from the REPL to shake any
+pipeline).
+
+Spark gave the reference a substrate that was *constantly* injected with
+faults in production (task preemption, straggler kills, bad input records);
+our JAX port has to earn that hardness on purpose.  Three fault families:
+
+* **corrupt data**: ``corrupt_jpeg`` mangles a valid JPEG stream (keeps the
+  SOI marker so the native decoder engages and must fail cleanly);
+  ``make_image_tar`` builds tar archives with chosen members corrupted or
+  truncated — the loader must skip-and-count, never crash.
+* **transient IO**: ``flaky`` / ``transient_faults`` wrap a callable (or
+  patch a module attribute) to raise ``OSError`` for the first N calls and
+  then behave — exercising core.resilience.retry's backoff path.
+* **poisoned numerics**: ``inject_nan`` sprinkles NaN into a batch;
+  ``rank_deficient_gram`` builds a gram whose unregularized Cholesky is
+  guaranteed to fail — exercising the solver jitter-retry and the
+  ``assert_all_finite`` fit guards.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import tarfile
+
+import numpy as np
+
+
+def make_jpeg_bytes(rng, h: int = 48, w: int = 48, quality: int = 90) -> bytes:
+    """A valid random-texture JPEG."""
+    from PIL import Image as PILImage
+
+    arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    PILImage.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def corrupt_jpeg(data: bytes, rng) -> bytes:
+    """Mangle a JPEG stream: keep the SOI marker (so decoders engage rather
+    than reject on sniffing), truncate the tail, and scramble a slice of
+    the entropy-coded body."""
+    n = len(data)
+    keep = max(8, n // 3)
+    body = bytearray(data[:keep])
+    lo = min(6, len(body) - 1)
+    scramble = rng.integers(0, 256, max(0, keep - lo), dtype=np.uint8)
+    body[lo:] = scramble.tobytes()
+    return bytes(body[:2] + body[2:])  # SOI preserved at [:2]
+
+
+def make_image_tar(
+    path: str,
+    n_images: int,
+    rng,
+    corrupt: tuple[int, ...] = (),
+    h: int = 48,
+    w: int = 48,
+    name_fmt: str = "img_{:04d}.jpg",
+) -> list[str]:
+    """Write a tar of JPEGs; members whose index is in ``corrupt`` carry
+    mangled JPEG bytes (decode must fail, mid-archive, without breaking
+    the members after them).  Returns the member names."""
+    names = []
+    with tarfile.open(path, "w") as tf:
+        for i in range(n_images):
+            data = make_jpeg_bytes(rng, h, w)
+            if i in corrupt:
+                data = corrupt_jpeg(data, rng)
+            info = tarfile.TarInfo(name_fmt.format(i))
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+            names.append(info.name)
+    return names
+
+
+def truncate_tail(path: str, nbytes: int = 1024) -> None:
+    """Chop the last ``nbytes`` off an archive — a partially-transferred
+    tar whose final member (and end-of-archive blocks) are gone."""
+    import os
+
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(max(0, size - nbytes))
+
+
+def flaky(fn, failures: int, exc: type[BaseException] = OSError, message: str = "injected transient fault"):
+    """Wrap ``fn`` to raise ``exc`` for its first ``failures`` calls, then
+    delegate.  The wrapper exposes ``.calls`` and ``.failures_left``."""
+    state = {"calls": 0, "left": failures}
+
+    def wrapped(*args, **kwargs):
+        state["calls"] += 1
+        if state["left"] > 0:
+            state["left"] -= 1
+            raise exc(f"{message} (call {state['calls']})")
+        return fn(*args, **kwargs)
+
+    wrapped.state = state
+    return wrapped
+
+
+@contextlib.contextmanager
+def transient_faults(obj, attr: str, failures: int, exc: type[BaseException] = OSError):
+    """Patch ``obj.attr`` with a :func:`flaky` wrapper for the duration of
+    the block — e.g. ``transient_faults(image_loaders.tarfile, "open", 2)``
+    makes the next two tar opens fail with OSError."""
+    original = getattr(obj, attr)
+    wrapper = flaky(original, failures, exc)
+    setattr(obj, attr, wrapper)
+    try:
+        yield wrapper
+    finally:
+        setattr(obj, attr, original)
+
+
+def inject_nan(batch, rng, frac: float = 0.01):
+    """Copy of ``batch`` with ~``frac`` of entries replaced by NaN."""
+    out = np.array(batch, copy=True)
+    flat = out.reshape(-1)
+    k = max(1, int(frac * flat.size))
+    idx = rng.choice(flat.size, k, replace=False)
+    flat[idx] = np.nan
+    return out
+
+
+def rank_deficient_gram(rng, n: int = 32, d: int = 8, k: int = 2):
+    """(AᵀA, AᵀB) from a design matrix with duplicated columns — the
+    unregularized gram is singular, so ``cho_factor`` yields non-finite
+    values and only jitter recovery can solve it."""
+    a = rng.normal(size=(n, d)).astype(np.float32)
+    a[:, d // 2 :] = a[:, : d - d // 2]
+    b = rng.normal(size=(n, k)).astype(np.float32)
+    return a.T @ a, a.T @ b

@@ -1,0 +1,291 @@
+"""Checkpoint roundtrip coverage: every registered node type with fitted
+state — and full ``a >> b`` pipelines — must survive save/load with
+bit-identical leaves and identical ``__call__`` outputs, including in a
+fresh process (the acceptance bar for load-or-fit)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.core.checkpoint import (
+    CheckpointError,
+    checkpoint_exists,
+    load_or_fit,
+    load_pipeline,
+    save_pipeline,
+)
+from keystone_tpu.core.pipeline import Pipeline, transformer
+from keystone_tpu.ops.fisher import FisherVector
+from keystone_tpu.ops.stats import (
+    CosineRandomFeatures,
+    NormalizeRows,
+    RandomSignNode,
+    SignedHellingerMapper,
+    StandardScaler,
+    StandardScalerModel,
+)
+from keystone_tpu.ops.util import MatrixVectorizer
+from keystone_tpu.solvers.block import BlockLeastSquaresEstimator, BlockLinearMapper
+from keystone_tpu.solvers.gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
+from keystone_tpu.solvers.linear import LinearMapEstimator, LinearMapper
+from keystone_tpu.solvers.naive_bayes import NaiveBayesEstimator
+from keystone_tpu.solvers.pca import BatchPCATransformer, PCAEstimator, PCATransformer
+from keystone_tpu.solvers.whitening import ZCAWhitenerEstimator
+
+
+def _assert_leaves_bit_identical(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x = np.asarray(x)
+        y = np.asarray(y)
+        assert x.dtype == y.dtype
+        assert x.shape == y.shape
+        np.testing.assert_array_equal(x, y)
+
+
+def _roundtrip(tmp_path, node, batch, idx=0):
+    stem = str(tmp_path / f"ck_{idx}")
+    save_pipeline(stem, node)
+    loaded = load_pipeline(stem)
+    assert type(loaded) is type(node)
+    _assert_leaves_bit_identical(node, loaded)
+    np.testing.assert_array_equal(
+        np.asarray(node(batch)), np.asarray(loaded(batch))
+    )
+    return loaded
+
+
+class TestNodeRoundtrips:
+    def test_block_linear_mapper(self, tmp_path, rng):
+        x = jnp.asarray(rng.normal(size=(24, 10)), jnp.float32)
+        y = jnp.asarray(rng.normal(size=(24, 3)), jnp.float32)
+        model = BlockLeastSquaresEstimator(block_size=4, num_iter=2, lam=0.1).fit(x, y)
+        _roundtrip(tmp_path, model, x)
+
+    def test_pca(self, tmp_path, rng):
+        samples = jnp.asarray(rng.normal(size=(50, 12)), jnp.float32)
+        node = PCAEstimator(5).fit(samples)
+        assert isinstance(node, PCATransformer)
+        _roundtrip(tmp_path, node, samples)
+
+    def test_batch_pca(self, tmp_path, rng):
+        node = BatchPCATransformer(
+            jnp.asarray(rng.normal(size=(12, 5)), jnp.float32)
+        )
+        batch = jnp.asarray(rng.normal(size=(3, 12, 7)), jnp.float32)
+        _roundtrip(tmp_path, node, batch)
+
+    def test_zca(self, tmp_path, rng):
+        data = jnp.asarray(rng.normal(size=(40, 9)), jnp.float32)
+        node = ZCAWhitenerEstimator().fit(data)
+        _roundtrip(tmp_path, node, data)
+
+    def test_gmm(self, tmp_path, rng):
+        samples = jnp.asarray(rng.normal(size=(120, 6)), jnp.float32)
+        node = GaussianMixtureModelEstimator(4, max_iter=5).fit(samples)
+        _roundtrip(tmp_path, node, samples)
+
+    def test_naive_bayes(self, tmp_path, rng):
+        feats = rng.integers(0, 5, (30, 11)).astype(np.float32)
+        labels = rng.integers(0, 3, 30)
+        node = NaiveBayesEstimator(3).fit(feats, labels)
+        _roundtrip(tmp_path, node, jnp.asarray(feats))
+
+    def test_standard_scaler(self, tmp_path, rng):
+        data = jnp.asarray(rng.normal(size=(25, 7)), jnp.float32)
+        node = StandardScaler().fit(data)
+        assert isinstance(node, StandardScalerModel)
+        _roundtrip(tmp_path, node, data)
+
+    def test_linear_mapper_with_nested_scaler(self, tmp_path, rng):
+        x = jnp.asarray(rng.normal(size=(30, 6)), jnp.float32)
+        y = jnp.asarray(rng.normal(size=(30, 2)), jnp.float32)
+        node = LinearMapEstimator(lam=0.2).fit(x, y)
+        assert isinstance(node, LinearMapper)
+        assert node.feature_scaler is not None  # nested node roundtrips too
+        _roundtrip(tmp_path, node, x)
+
+    def test_fisher_vector_nests_gmm(self, tmp_path, rng):
+        gmm = GaussianMixtureModel(
+            rng.normal(size=(5, 3)),
+            np.abs(rng.normal(size=(5, 3))) + 0.5,
+            np.full(3, 1 / 3),
+        )
+        node = FisherVector(gmm)
+        batch = jnp.asarray(rng.normal(size=(2, 5, 9)), jnp.float32)
+        _roundtrip(tmp_path, node, batch)
+
+    def test_cosine_random_features(self, tmp_path, rng):
+        node = CosineRandomFeatures.create(6, 16, 0.5, jax.random.PRNGKey(0))
+        batch = jnp.asarray(rng.normal(size=(4, 6)), jnp.float32)
+        _roundtrip(tmp_path, node, batch)
+
+    def test_random_sign_node(self, tmp_path, rng):
+        node = RandomSignNode.create(10, jax.random.PRNGKey(1))
+        batch = jnp.asarray(rng.normal(size=(3, 10)), jnp.float32)
+        _roundtrip(tmp_path, node, batch)
+
+
+class TestPipelineRoundtrips:
+    def test_composed_pipeline(self, tmp_path, rng):
+        scaler = StandardScalerModel(
+            jnp.asarray(rng.normal(size=(8,)), jnp.float32),
+            jnp.asarray(np.abs(rng.normal(size=(8,))) + 0.5, jnp.float32),
+        )
+        pca = PCATransformer(jnp.asarray(rng.normal(size=(8, 4)), jnp.float32))
+        pipe = scaler >> pca >> NormalizeRows()
+        batch = jnp.asarray(rng.normal(size=(5, 8)), jnp.float32)
+        loaded = _roundtrip(tmp_path, pipe, batch)
+        assert isinstance(loaded, Pipeline)
+        assert [type(n).__name__ for n in loaded.nodes] == [
+            "StandardScalerModel", "PCATransformer", "NormalizeRows",
+        ]
+
+    def test_voc_style_fisher_pipeline(self, tmp_path, rng):
+        """The acceptance pipeline: PCA >> FisherVector(GMM) >> vectorize >>
+        L2 >> hellinger >> L2 >> block linear model, saved as ONE object and
+        reproducing predictions exactly."""
+        desc_dim, vocab, k_cls = 6, 4, 3
+        batch_pca = BatchPCATransformer(
+            jnp.asarray(rng.normal(size=(16, desc_dim)), jnp.float32)
+        )
+        gmm = GaussianMixtureModelEstimator(vocab, max_iter=4).fit(
+            jnp.asarray(rng.normal(size=(200, desc_dim)), jnp.float32)
+        )
+        feat_dim = 2 * desc_dim * vocab
+        feats_rng = jnp.asarray(rng.normal(size=(20, feat_dim)), jnp.float32)
+        labels = jnp.asarray(rng.normal(size=(20, k_cls)), jnp.float32)
+        model = BlockLeastSquaresEstimator(block_size=16, num_iter=1, lam=0.1).fit(
+            feats_rng, labels
+        )
+        pipe = Pipeline(
+            [
+                batch_pca,
+                FisherVector(gmm),
+                MatrixVectorizer(),
+                NormalizeRows(),
+                SignedHellingerMapper(),
+                NormalizeRows(),
+                model,
+            ]
+        )
+        descs = jnp.asarray(rng.normal(size=(7, 16, 30)), jnp.float32)
+        _roundtrip(tmp_path, pipe, descs)
+
+    def test_dict_bundle(self, tmp_path, rng):
+        pca = PCATransformer(jnp.asarray(rng.normal(size=(6, 3)), jnp.float32))
+        gmm = GaussianMixtureModel(
+            rng.normal(size=(3, 2)), np.abs(rng.normal(size=(3, 2))) + 1, [0.5, 0.5]
+        )
+        stem = str(tmp_path / "bundle")
+        save_pipeline(stem, {"pca": pca, "gmm": gmm})
+        loaded = load_pipeline(stem)
+        assert set(loaded) == {"pca", "gmm"}
+        _assert_leaves_bit_identical(pca, loaded["pca"])
+        _assert_leaves_bit_identical(gmm, loaded["gmm"])
+
+
+class TestCheckpointContract:
+    def test_load_or_fit_fits_then_loads(self, tmp_path, rng):
+        x = jnp.asarray(rng.normal(size=(20, 8)), jnp.float32)
+        stem = str(tmp_path / "lof")
+        calls = []
+
+        class CountingPCA(PCAEstimator):
+            def fit(self, samples):
+                calls.append(1)
+                return super().fit(samples)
+
+        est = CountingPCA(3)
+        first = load_or_fit(stem, est, x)
+        assert checkpoint_exists(stem) and len(calls) == 1
+        second = load_or_fit(stem, est, x)
+        assert len(calls) == 1  # loaded, not refit
+        _assert_leaves_bit_identical(first, second)
+
+    def test_function_transformer_is_rejected(self, tmp_path):
+        pipe = transformer(lambda b: b * 2)
+        with pytest.raises(CheckpointError, match="FunctionTransformer"):
+            save_pipeline(str(tmp_path / "bad"), pipe)
+
+    def test_corrupt_manifest_rejected(self, tmp_path, rng):
+        pca = PCATransformer(jnp.asarray(rng.normal(size=(4, 2)), jnp.float32))
+        stem = str(tmp_path / "ck")
+        save_pipeline(stem, pca)
+        with open(stem + ".json") as fh:
+            manifest = json.load(fh)
+        manifest["arrays"]["a0"]["shape"] = [9, 9]
+        with open(stem + ".json", "w") as fh:
+            json.dump(manifest, fh)
+        with pytest.raises(CheckpointError, match="corrupt|schema"):
+            load_pipeline(stem)
+
+    def test_version_mismatch_rejected(self, tmp_path, rng):
+        pca = PCATransformer(jnp.asarray(rng.normal(size=(4, 2)), jnp.float32))
+        stem = str(tmp_path / "ck")
+        save_pipeline(stem, pca)
+        with open(stem + ".json") as fh:
+            manifest = json.load(fh)
+        manifest["version"] = 99
+        with open(stem + ".json", "w") as fh:
+            json.dump(manifest, fh)
+        with pytest.raises(CheckpointError, match="version"):
+            load_pipeline(stem)
+
+    def test_bfloat16_leaves_roundtrip(self, tmp_path, rng):
+        node = PCATransformer(
+            jnp.asarray(rng.normal(size=(6, 3)), jnp.bfloat16)
+        )
+        stem = str(tmp_path / "bf16")
+        save_pipeline(stem, node)
+        loaded = load_pipeline(stem)
+        assert loaded.pca_mat.dtype == jnp.bfloat16
+        _assert_leaves_bit_identical(node, loaded)
+
+
+class TestFreshProcessReload:
+    def test_predictions_identical_in_fresh_process(self, tmp_path, rng):
+        """fit -> save -> reload in a NEW interpreter -> identical scores."""
+        x = jnp.asarray(rng.normal(size=(24, 10)), jnp.float32)
+        y = jnp.asarray(rng.normal(size=(24, 3)), jnp.float32)
+        scaler = StandardScaler().fit(x)
+        model = BlockLeastSquaresEstimator(block_size=4, num_iter=2, lam=0.1).fit(
+            scaler(x), y
+        )
+        pipe = Pipeline([scaler, model])
+        stem = str(tmp_path / "fresh")
+        save_pipeline(stem, pipe)
+        expected = np.asarray(pipe(x))
+        np.save(tmp_path / "input.npy", np.asarray(x))
+        np.save(tmp_path / "expected.npy", expected)
+        script = (
+            "import os; os.environ['JAX_PLATFORMS']='cpu'\n"
+            "import numpy as np, jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from keystone_tpu.core.checkpoint import load_pipeline\n"
+            f"pipe = load_pipeline({stem!r})\n"
+            f"x = np.load({str(tmp_path / 'input.npy')!r})\n"
+            f"expected = np.load({str(tmp_path / 'expected.npy')!r})\n"
+            "got = np.asarray(pipe(x))\n"
+            "np.testing.assert_array_equal(got, expected)\n"
+            "print('FRESH_PROCESS_OK')\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        res = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert "FRESH_PROCESS_OK" in res.stdout

@@ -1,0 +1,272 @@
+"""Fault-tolerance suite: loader skip-and-count, IO retry, solver jitter
+recovery, finite-fit guards, and resumable BCD — driven by the injection
+harness in tests/faults.py.  All tier-1 fast (no `slow` marks)."""
+
+import logging
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import keystone_tpu.loaders.image_loaders as il
+from keystone_tpu.core.checkpoint import CheckpointError
+from keystone_tpu.core.resilience import (
+    assert_all_finite,
+    counters,
+    retry,
+)
+from keystone_tpu.solvers.block import (
+    BlockLeastSquaresEstimator,
+    load_bcd_checkpoint,
+)
+from keystone_tpu.solvers.normal_equations import solve_gram_l2
+
+from faults import (  # tests/ is on sys.path under pytest's default import mode
+    flaky,
+    inject_nan,
+    make_image_tar,
+    rank_deficient_gram,
+    transient_faults,
+    truncate_tail,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    counters.reset()
+    yield
+    counters.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_IO_BACKOFF", "0.001")
+
+
+class TestLoaderFaults:
+    def test_corrupt_member_mid_tar_is_counted_skip(self, tmp_path, rng):
+        tar = str(tmp_path / "imgs.tar")
+        make_image_tar(tar, 6, rng, corrupt=(2, 3))
+        got = list(il._iter_tar_images(tar, num_threads=1))
+        assert len(got) == 4  # the 4 healthy members decode
+        assert counters.get("corrupt_image") == 2
+
+    def test_corrupt_member_counted_under_thread_pool(self, tmp_path, rng):
+        tar = str(tmp_path / "imgs.tar")
+        make_image_tar(tar, 8, rng, corrupt=(0, 5))
+        got = list(il._iter_tar_images(tar, num_threads=4))
+        assert len(got) == 6
+        assert counters.get("corrupt_image") == 2
+
+    def test_truncated_tar_tail_survived(self, tmp_path, rng):
+        tar = str(tmp_path / "imgs.tar")
+        make_image_tar(tar, 5, rng)
+        # cut mid-archive (tar pads to 10 KiB records, so a small trim only
+        # removes padding): half the members and the end-of-archive marker
+        # are gone
+        truncate_tail(tar, os.path.getsize(tar) // 2)
+        got = list(il._iter_tar_images(tar, num_threads=1))
+        # everything before the cut still loads; the damaged tail is
+        # counted (stream/member error or failed decode); nothing crashes
+        assert 1 <= len(got) < 5
+        total_faults = sum(counters.counts().values())
+        assert total_faults >= 1
+
+    def test_transient_open_error_retried(self, tmp_path, rng):
+        tar = str(tmp_path / "imgs.tar")
+        make_image_tar(tar, 3, rng)
+        with transient_faults(il.tarfile, "open", failures=2):
+            got = list(il._iter_tar_images(tar, num_threads=1))
+        assert len(got) == 3
+        assert counters.get("io_retry") == 2
+
+    def test_retry_exhaustion_raises(self, tmp_path, rng):
+        tar = str(tmp_path / "imgs.tar")
+        make_image_tar(tar, 2, rng)
+        with transient_faults(il.tarfile, "open", failures=99):
+            with pytest.raises(OSError):
+                list(il._iter_tar_images(tar, num_threads=1))
+
+    def test_decode_rejects_corrupt_bytes(self, rng):
+        from faults import corrupt_jpeg, make_jpeg_bytes
+
+        good = make_jpeg_bytes(rng)
+        assert il.decode_image(good) is not None
+        assert il.decode_image(corrupt_jpeg(good, rng)) is None
+
+
+class TestRetryPrimitive:
+    def test_succeeds_after_transient_failures(self):
+        fn = flaky(lambda: "ok", failures=2)
+        assert retry(fn, attempts=3, backoff=0.001)() == "ok"
+        assert fn.state["calls"] == 3
+
+    def test_non_retryable_exception_propagates_immediately(self):
+        fn = flaky(lambda: "ok", failures=5, exc=ValueError)
+        with pytest.raises(ValueError):
+            retry(fn, attempts=5, backoff=0.001)()
+        assert fn.state["calls"] == 1  # ValueError is not transient
+
+    def test_timeout_budget_caps_attempts(self):
+        fn = flaky(lambda: "ok", failures=50)
+        with pytest.raises(OSError):
+            retry(fn, attempts=50, backoff=0.05, timeout=0.01)()
+        assert fn.state["calls"] < 50
+
+
+class TestNumericsGuards:
+    def test_jitter_retry_recovers_rank_deficient_gram(self, rng, caplog):
+        ata, atb = rank_deficient_gram(rng)
+        with caplog.at_level(logging.WARNING, "keystone_tpu.solvers.normal_equations"):
+            x = solve_gram_l2(jnp.asarray(ata), jnp.asarray(atb), 0.0)
+        assert bool(jnp.all(jnp.isfinite(x)))
+        assert any("jitter" in r.message for r in caplog.records)
+
+    def test_nonfinite_gram_raises(self, rng):
+        ata, atb = rank_deficient_gram(rng)
+        ata[0, 0] = np.nan
+        with pytest.raises(FloatingPointError):
+            solve_gram_l2(jnp.asarray(ata), jnp.asarray(atb), 0.1)
+
+    def test_guard_can_be_disabled(self, rng, monkeypatch):
+        monkeypatch.setenv("KEYSTONE_NUMERICS_GUARD", "0")
+        ata, atb = rank_deficient_gram(rng)
+        x = solve_gram_l2(jnp.asarray(ata), jnp.asarray(atb), 0.0)
+        assert not bool(jnp.all(jnp.isfinite(x)))  # unguarded = raw NaNs
+
+    def test_nan_batch_poisons_fit_and_is_caught(self, rng):
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = rng.normal(size=(32, 2)).astype(np.float32)
+        x_bad = inject_nan(x, rng, frac=0.02)
+        est = BlockLeastSquaresEstimator(block_size=4, num_iter=1, lam=0.1)
+        model = est.fit(jnp.asarray(x_bad), jnp.asarray(y))
+        with pytest.raises(FloatingPointError):
+            assert_all_finite(model, "poisoned fit")
+
+    def test_assert_all_finite_passes_clean_tree(self, rng):
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = rng.normal(size=(32, 2)).astype(np.float32)
+        est = BlockLeastSquaresEstimator(block_size=4, num_iter=1, lam=0.1)
+        model = est.fit(jnp.asarray(x), jnp.asarray(y))
+        assert assert_all_finite(model, "clean fit") is model
+
+
+class _KillAfter(Exception):
+    pass
+
+
+class TestResumableBCD:
+    def _data(self, rng, n=96, d=22, k=3):
+        x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+        y = jnp.asarray(rng.normal(size=(n, k)), jnp.float32)
+        return x, y
+
+    def test_stepwise_matches_fused(self, rng):
+        x, y = self._data(rng)
+        est = BlockLeastSquaresEstimator(block_size=6, num_iter=2, lam=0.05)
+        fused = est.fit(x, y)
+        seen = []
+        stepwise = est.fit(x, y, checkpoint=seen.append)
+        np.testing.assert_allclose(
+            np.asarray(fused(x)), np.asarray(stepwise(x)), atol=1e-4
+        )
+        # one state per (epoch, block): ceil(22/6)=4 blocks x 2 epochs
+        assert len(seen) == 8
+        assert (seen[-1]["epoch"], seen[-1]["block"]) == (1, 3)
+
+    def test_interrupted_fit_resumes_from_disk(self, rng, tmp_path):
+        x, y = self._data(rng)
+        est = BlockLeastSquaresEstimator(block_size=6, num_iter=2, lam=0.05)
+        fused = est.fit(x, y)
+
+        path = str(tmp_path / "bcd_state")
+        from keystone_tpu.solvers.block import bcd_checkpoint_writer
+
+        write = bcd_checkpoint_writer(path)
+        fired = []
+
+        def killer(state):
+            write(state)
+            fired.append(state["block"])
+            if len(fired) == 3:  # die mid-epoch, after block 2 of 4
+                raise _KillAfter
+
+        with pytest.raises(_KillAfter):
+            est.fit(x, y, checkpoint=killer)
+        assert os.path.exists(path + ".npz")
+
+        state = load_bcd_checkpoint(path)
+        assert (state["epoch"], state["block"]) == (0, 2)
+
+        resumed = est.fit(x, y, checkpoint=path, resume_from=path)
+        np.testing.assert_allclose(
+            np.asarray(fused(x)), np.asarray(resumed(x)), atol=1e-4
+        )
+
+    def test_resume_rejects_mismatched_fit(self, rng, tmp_path):
+        x, y = self._data(rng)
+        est = BlockLeastSquaresEstimator(block_size=6, num_iter=2, lam=0.05)
+        path = str(tmp_path / "bcd_state")
+        est.fit(x, y, checkpoint=path)  # completes; final state on disk
+        other = BlockLeastSquaresEstimator(block_size=9, num_iter=2, lam=0.05)
+        with pytest.raises(CheckpointError):
+            other.fit(x, y, resume_from=path)
+        # a different regularizer must also be rejected — resuming with it
+        # would mix two lambdas in one model
+        relam = BlockLeastSquaresEstimator(block_size=6, num_iter=2, lam=0.5)
+        with pytest.raises(CheckpointError, match="lam"):
+            relam.fit(x, y, resume_from=path)
+
+    def test_resume_rejects_different_data(self, rng, tmp_path):
+        from keystone_tpu.solvers.block import save_bcd_checkpoint
+
+        x, y = self._data(rng)
+        est = BlockLeastSquaresEstimator(block_size=6, num_iter=2, lam=0.05)
+        path = str(tmp_path / "bcd_state")
+
+        def killer(state):
+            save_bcd_checkpoint(path, state)
+            raise _KillAfter
+
+        with pytest.raises(_KillAfter):
+            est.fit(x, y, checkpoint=killer)
+        # same shapes, different content: the data fingerprint must refuse
+        with pytest.raises(CheckpointError, match="DIFFERENT data"):
+            est.fit(x * 2.0, y, resume_from=path)
+
+    def test_completed_state_resume_is_idempotent(self, rng, tmp_path):
+        x, y = self._data(rng)
+        est = BlockLeastSquaresEstimator(block_size=6, num_iter=1, lam=0.05)
+        path = str(tmp_path / "bcd_state")
+        first = est.fit(x, y, checkpoint=path)
+        again = est.fit(x, y, checkpoint=path, resume_from=path)
+        np.testing.assert_allclose(
+            np.asarray(first(x)), np.asarray(again(x)), atol=1e-5
+        )
+
+    def test_checkpoint_under_mesh_rejected(self, rng, mesh8):
+        x, y = self._data(rng)
+        est = BlockLeastSquaresEstimator(
+            block_size=6, num_iter=1, lam=0.05, mesh=mesh8
+        )
+        with pytest.raises(ValueError):
+            est.fit(x, y, checkpoint=lambda s: None)
+
+
+class TestBlockedDesignContract:
+    def test_num_features_beyond_matrix_raises(self, rng):
+        from keystone_tpu.solvers.block import _blocked_design_matrix
+
+        feats = rng.normal(size=(10, 8)).astype(np.float32)
+        with pytest.raises(ValueError, match="num_features"):
+            _blocked_design_matrix(feats, block_size=4, num_features=12)
+
+    def test_valid_num_features_still_slices(self, rng):
+        from keystone_tpu.solvers.block import _blocked_design_matrix
+
+        feats = rng.normal(size=(10, 8)).astype(np.float32)
+        x, widths = _blocked_design_matrix(feats, block_size=4, num_features=6)
+        assert widths == (4, 2)
+        assert x.shape == (10, 8)  # 2 blocks x bs=4, short block zero-padded
+        np.testing.assert_array_equal(np.asarray(x[:, 6:8]), 0.0)
