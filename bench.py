@@ -525,8 +525,9 @@ def bench_stage_ops(rng):
         # the ImageNet pipeline's solver tail, the whole solve one compiled
         # program.  Beyond steady-state wall, the round-5 rigor ask: device
         # seconds + cost analysis of the fused solve program itself, and a
-        # wall breakdown separating host prep / the regroup gather / the
-        # solve so the wall number is explained, not just quoted.
+        # wall breakdown whose components are each measured at the REAL
+        # shape the fit runs (VERDICT r5 weak #2: the old breakdown summed
+        # to more than wall with nothing saying the components overlapped).
         import keystone_tpu.solvers.weighted as wsolver
         from keystone_tpu.solvers.weighted import (
             BlockWeightedLeastSquaresEstimator,
@@ -540,50 +541,87 @@ def bench_stage_ops(rng):
         )
 
         # Capture the exact arguments the fit hands the fused program so it
-        # can be AOT-timed in isolation (no duplicated preprocessing logic).
+        # can be AOT-timed in isolation (no duplicated preprocessing
+        # logic).  The capture substitutes the NON-donating variant so the
+        # captured buffers survive the fit for the isolated timing below;
+        # both the warm and the timed fit run through it, so the timed
+        # wall never includes a donating-variant compile.
         captured = {}
-        orig = wsolver._fused_bwls_fit
+        orig_exec = wsolver._execute_fused_bwls
 
-        def capture(*args, **kw):
-            captured["args"], captured["kw"] = args, kw
-            return orig(*args, **kw)
+        def capture(plan, args, statics):
+            captured["args"], captured["statics"] = args, statics
+            return wsolver._fused_bwls_fit(*args, *statics)
 
-        wsolver._fused_bwls_fit = capture
+        wsolver._execute_fused_bwls = capture
         try:
             m0 = bwls.fit(xw, yw)  # warm: compiles every program + captures
-        finally:
-            wsolver._fused_bwls_fit = orig
-        float(sum(jnp.sum(x) for x in m0.xs))  # sync
+            float(sum(jnp.sum(x) for x in m0.xs))  # sync
 
-        # Steady-state wall of the WHOLE fit (perturbed input defeats
-        # transport dedup; relative perturbation per the solve-timing note).
-        xw_t = xw * jnp.float32(1.0 + 1e-6)
-        float(jnp.sum(xw_t[0]))
-        t0 = time.perf_counter()
-        m1 = bwls.fit(xw_t, yw)
-        float(sum(jnp.sum(x) for x in m1.xs))
-        wall = time.perf_counter() - t0
+            # Steady-state wall of the WHOLE fit (perturbed input defeats
+            # transport dedup; relative perturbation per the solve-timing
+            # note).
+            xw_t = xw * jnp.float32(1.0 + 1e-6)
+            float(jnp.sum(xw_t[0]))
+            t0 = time.perf_counter()
+            m1 = bwls.fit(xw_t, yw)
+            float(sum(jnp.sum(x) for x in m1.xs))
+            wall = time.perf_counter() - t0
+        finally:
+            wsolver._execute_fused_bwls = orig_exec
+
+        if "args" not in captured:
+            # The fit's ladder never reached the fused tier (budget denied
+            # it and stepwise/host-staged ran): the AOT isolation below is
+            # meaningless — record the wall + the ladder's own audit trail.
+            rep = bwls.last_fit_report
+            return {
+                "n": n_b, "d": d_b, "classes": c_b,
+                "wall_seconds": round(wall, 3),
+                "note": "fused tier not chosen; AOT solve isolation skipped",
+                "solver_report": rep.record() if rep is not None else None,
+            }
 
         # Host prep: argmax pull + argsort + index builds, measured directly.
         t0 = time.perf_counter()
         ci = np.asarray(jnp.argmax(yw, axis=1))
-        np.argsort(ci, kind="stable")
+        order_np = np.argsort(ci, kind="stable")
         host_prep = time.perf_counter() - t0
 
-        # The regroup gather (no-mesh fallback: one jnp.take per column
-        # chunk) timed as its own program on the same shape.
-        order_idx = jnp.asarray(np.random.default_rng(0).permutation(n_b))
+        # The regroup gather timed on the REAL fallback path the fit runs
+        # (ADVICE r5 low): p_tot = n + n_max rows, column-chunked takes
+        # into a preallocated output — for BOTH the design matrix and the
+        # labels, since the fit sorts each.
+        n_max_b = int(np.bincount(ci, minlength=c_b).max())
+        p_tot_b = n_b + n_max_b
+        gather_np = np.concatenate(
+            [order_np, np.full(p_tot_b - n_b, n_b, order_np.dtype)]
+        )
+        gidx = jnp.asarray(gather_np)
+        vmask = jnp.asarray((gather_np < n_b).astype(np.float32))[:, None]
+        chunk_cols = max(1, wsolver._GATHER_COL_CHUNK // 4)
 
         def regroup(xx):
-            return jnp.take(xx, order_idx, axis=0, mode="fill", fill_value=0)
+            out = jnp.zeros((p_tot_b, xx.shape[1]), xx.dtype)
+            for c0 in range(0, xx.shape[1], chunk_cols):
+                sl = jax.lax.slice_in_dim(
+                    xx, c0, min(c0 + chunk_cols, xx.shape[1]), axis=1
+                )
+                g = jnp.take(sl, gidx, axis=0, mode="fill", fill_value=0)
+                out = wsolver._scatter_cols(out, g * vmask, jnp.int32(c0))
+            return out
 
-        regroup_dev = timed_chain_auto(regroup, xw, chain_len=64)
+        regroup_x = timed_chain_auto(regroup, xw, chain_len=64)
+        regroup_y = timed_chain_auto(regroup, yw, chain_len=64)
+        regroup_dev = regroup_x + regroup_y
 
-        # The fused solve program, AOT-compiled then executed once with a
-        # perturbed lam operand (same program, fresh input -> no dedup).
-        args, kw = captured["args"], captured["kw"]
-        lowered = orig.lower(*args, **kw)
-        compiled = lowered.compile()
+        # The fused solve program, AOT-compiled then executed in a serial
+        # chain with a perturbed lam operand (same program, fresh input ->
+        # no dedup).  args layout: (x, labels_sorted, valid, seg_ids,
+        # starts, counts, counts_f, joint_label_mean, nvalid, lam, w).
+        args, statics = captured["args"], captured["statics"]
+        orig = wsolver._fused_bwls_fit
+        compiled = orig.lower(*args, *statics).compile()
         flops, bytes_accessed = None, None
         try:
             ca = compiled.cost_analysis()
@@ -593,32 +631,40 @@ def bench_stage_ops(rng):
             bytes_accessed = float(ca.get("bytes accessed", 0.0)) or None
         except Exception:
             pass
-        # args layout: (x, labels_sorted, valid, seg_ids, starts, counts,
-        # counts_f, joint_label_mean, nvalid, lam, w, ...statics); perturb
-        # lam (index 9) so repeats are never bit-identical invocations.
         solve_dev = timed_chain_auto(
             lambda xs: orig(
-                xs, *args[1:9], args[9] * jnp.float32(1.000001), *args[10:],
-                **kw,
+                xs, *args[1:9], args[9] * jnp.float32(1.000001), args[10],
+                *statics,
             )[0],
             args[0],
             chain_len=16,
         )
         lat = roundtrip_latency()
+        explained = host_prep + regroup_dev + solve_dev + 2 * lat
+        rep = bwls.last_fit_report
         return {
             "n": n_b, "d": d_b, "classes": c_b,
             "wall_seconds": round(wall, 3),
-            "solve_device_seconds": round(solve_dev, 4),
-            "regroup_device_seconds": round(regroup_dev, 4),
-            "host_prep_seconds": round(host_prep, 4),
+            # DISJOINT phases of a fit, each measured independently at the
+            # true shape: host prep (argmax pull + argsort), the two sort
+            # gathers (design matrix + labels), the fused solve program,
+            # and two dispatch round-trips (argmax pull; model pull).
+            "wall_breakdown": {
+                "host_prep_seconds": round(host_prep, 4),
+                "regroup_device_seconds": round(regroup_dev, 4),
+                "solve_device_seconds": round(solve_dev, 4),
+                "dispatch_roundtrips_seconds": round(2 * lat, 4),
+            },
+            "wall_explained_seconds": round(explained, 3),
+            # >= 0: enqueue/tracing overhead not separately measured;
+            # < 0: the independently-measured components overlapped inside
+            # wall (async dispatch lets device work run under host prep) —
+            # the breakdown is a cost model, NOT a partition of wall.
+            "wall_unattributed_seconds": round(wall - explained, 3),
             "roundtrip_latency_seconds": round(lat, 4),
             "solve_flops": flops,
             "solve_bytes_accessed": bytes_accessed,
-            # wall ≈ host prep + regroup + solve + ~2 dispatch round-trips
-            # (argmax pull; final model pull) + enqueue overhead.
-            "wall_explained_seconds": round(
-                host_prep + regroup_dev + solve_dev + 2 * lat, 3
-            ),
+            "solver_report": rep.record() if rep is not None else None,
         }
 
     @stage("gmm_em_fit")
@@ -661,32 +707,62 @@ def bench_stage_ops(rng):
 
 def bench_solve_at_scale(rng):
     """The fused BCD solve at the largest single-chip-HBM shape that fits
-    (VERDICT r4 #2): the flagship one-program claim exercised where memory
-    behavior actually matters, not at toy shapes.  Data is device-generated
-    (nothing crosses the tunnel), the program is AOT-compiled so the timed
-    dispatch is pure execution, and XLA's compiled memory analysis reports
-    the true peak footprint.  Failed (OOM) shapes are recorded — the
-    largest-fittable boundary is part of the result.  The reference's
-    north-star solve is 1.25M x 256k spread across a cluster
+    (VERDICT r4 #2, r5 #1): the flagship one-program claim exercised where
+    memory behavior actually matters.  Round-6 discipline: every probed
+    shape is PREFLIGHTED first — AOT lower+compile on ShapeDtypeStructs
+    (nothing allocated), ``memory_analysis()`` breakdown recorded for every
+    shape including failures, admission checked against the live HBM budget
+    — so the OOM boundary is measured, not guessed; and the design matrix +
+    labels are DONATED into the solve, so residual/centered-block temps
+    reuse their HBM instead of doubling it (the round-5 form held x + temps
+    simultaneously and could not place even 4 GB on a 16 GB chip).  The
+    reference's north-star solve is 1.25M x 256k spread across a cluster
     (ImageNetSiftLcsFV.scala:186-188); per chip that is ~40 GB of design
     matrix per 16 GB-HBM v5e at f32, so single-chip proof means the
     largest shape HBM admits, with the mesh path scaling rows/classes out.
     """
-    from keystone_tpu.solvers.block import _fused_bcd_fit
+    from keystone_tpu.core import memory as kmem
+    from keystone_tpu.solvers.block import _fused_bcd_fit_variant
 
     k_cls = 128
     bs = 4096
     shapes = [  # (n, d) descending footprint; ~GB = n*d*4/2**30
-        (262144, 16384),  # 16.0 GB design matrix — expected OOM, recorded
+        (262144, 16384),  # 16.0 GB design matrix — expected deny, recorded
         (196608, 16384),  # 12.0 GB
         (163840, 16384),  # 10.0 GB
         (131072, 16384),  # 8.0 GB
         (131072, 8192),   # 4.0 GB
     ]
+    budget = kmem.hbm_budget()
+    fn = _fused_bcd_fit_variant((0, 1))  # x and labels donated
     attempts = []
     result = None
     for n, d in shapes:
         widths = (bs,) * (d // bs)
+        sds = jax.ShapeDtypeStruct
+        plan = kmem.plan_program(
+            fn,
+            sds((n, d), jnp.float32), sds((n, k_cls), jnp.float32),
+            sds((), jnp.float32), sds((), jnp.int32),
+            1, widths, None,
+            label=f"bcd_at_scale_{n}x{d}", budget=budget,
+            require_analysis=True,
+        )
+        rec = {
+            "n": n, "d": d,
+            "design_matrix_gb": round(n * d * 4 / 2**30, 2),
+            "preflight": plan.breakdown(),
+        }
+        if plan.error is not None:
+            attempts.append(
+                {**rec, "error": f"preflight compile failed: {plan.error[:160]}"}
+            )
+            continue
+        if budget is not None and not plan.admitted:
+            # Denied before any allocation: the breakdown says exactly
+            # whose bytes would not fit.
+            attempts.append({**rec, "error": f"preflight denied: {plan.reason}"})
+            continue
         try:
             key = jax.random.PRNGKey(n % 97)
 
@@ -700,155 +776,133 @@ def bench_solve_at_scale(rng):
 
             x, y = make()
             x.block_until_ready()
-            lam = jnp.float32(10.0)
-            nv = jnp.int32(n)
-            lowered = _fused_bcd_fit.lower(
-                x, y, lam, nv, 1, widths, None
-            )
-            compiled = lowered.compile()
+            lam = jnp.asarray(10.0, jnp.float32)
+            nv = jnp.asarray(n, jnp.int32)
             flops = bytes_accessed = None
             try:
-                ca = compiled.cost_analysis()
+                ca = plan.compiled.cost_analysis()
                 if isinstance(ca, (list, tuple)):
                     ca = ca[0]
                 flops = float(ca.get("flops", 0.0)) or None
                 bytes_accessed = float(ca.get("bytes accessed", 0.0)) or None
             except Exception:
                 pass
-            mem = {}
-            try:
-                ma = compiled.memory_analysis()
-                mem = {
-                    "argument_gb": round(ma.argument_size_in_bytes / 2**30, 2),
-                    "temp_gb": round(ma.temp_size_in_bytes / 2**30, 2),
-                    "output_gb": round(ma.output_size_in_bytes / 2**30, 2),
-                }
-            except Exception:
-                pass
-            # First execution of a fresh AOT executable: nothing to dedup.
+            # First (and only) execution of a fresh AOT executable: nothing
+            # to dedup.  Donation consumes x/y, so there is no second run —
+            # and no second resident copy, which is the point.
             t0 = time.perf_counter()
-            models, label_mean, means = compiled(x, y, lam, nv)
+            models, label_mean, means = plan.compiled(x, y, lam, nv)
             float(jnp.sum(models))  # scalar pull = the sync
             dt = time.perf_counter() - t0
-            # Second run, perturbed lam operand (same program, fresh input).
-            t0 = time.perf_counter()
-            models, _, _ = compiled(x, y, lam * jnp.float32(1.000001), nv)
-            float(jnp.sum(models))
-            dt = min(dt, time.perf_counter() - t0)
             result = {
-                "n": n, "d": d, "block_size": bs, "classes": k_cls,
+                **rec, "block_size": bs, "classes": k_cls,
                 "blocks": len(widths),
-                "design_matrix_gb": round(n * d * 4 / 2**30, 2),
                 "wall_seconds": round(dt, 3),
                 "examples_per_sec": round(n / dt, 1),
                 "flops": flops,
                 "bytes_accessed": bytes_accessed,
                 "flops_per_sec": round(flops / dt, 3) if flops else None,
-                "memory_analysis": mem,
+                "memory_analysis": plan.breakdown(),
+                "donated_design_matrix": True,
+                "hbm_budget_gb": (
+                    round(budget / 2**30, 2) if budget is not None else None
+                ),
             }
             break
         except Exception as e:  # noqa: BLE001 — OOM boundary is data
-            attempts.append({
-                "n": n, "d": d,
-                "design_matrix_gb": round(n * d * 4 / 2**30, 2),
-                "error": f"{type(e).__name__}: {e}"[:160],
-            })
+            attempts.append({**rec, "error": f"{type(e).__name__}: {e}"[:160]})
             x = y = None  # free HBM before the next probe
     if result is None:
-        return {"error": "no probed shape fit", "attempts": attempts}
+        # Even with every BCD shape denied/failed, the BWLS probe still
+        # runs (its estimator ladder can succeed via stepwise/host-staged
+        # on exactly this kind of memory-starved chip) and the probe's
+        # cached executables are still released first.
+        kmem.clear_plan_cache()
+        return {
+            "error": "no probed shape fit",
+            "attempts": attempts,
+            "bwls": _guarded(_bench_bwls_at_scale, rng),
+        }
     result["oom_attempts"] = attempts
-    # Release this probe's device buffers (design matrix + labels up to
-    # 16 GB, plus models/means) and drop the executable BEFORE the nested
-    # BWLS bench allocates its own multi-GB matrix — leaving them live
-    # OOMed the nested probe on 16 GB-HBM chips (ADVICE r5).
+    # Release this probe's device buffers (donation already consumed x/y;
+    # models/means remain) and drop every probed shape's executable — the
+    # plan cache holds them, and loaded executables can reserve device
+    # program memory — BEFORE the nested BWLS bench allocates its own
+    # multi-GB matrix; leaving buffers live OOMed the nested probe on
+    # 16 GB-HBM chips (ADVICE r5).
     x = y = models = label_mean = means = None  # noqa: F841
-    compiled = lowered = None  # noqa: F841
+    plan = None  # noqa: F841
+    kmem.clear_plan_cache()
     result["bwls"] = _guarded(_bench_bwls_at_scale, rng)
     return result
 
 
 def _bench_bwls_at_scale(rng):
-    """_fused_bwls_fit at a scale that stresses HBM (VERDICT r4 #2): the
-    whole class-weighted fit on a multi-GB device-generated design matrix,
-    with the fused program AOT-isolated via argument capture."""
-    import keystone_tpu.solvers.weighted as wsolver
+    """The whole class-weighted fit at HBM-stressing scale (VERDICT r4 #2,
+    r5 #1), probed through the estimator's OWN admission-control ladder:
+    each shape's fit preflights fused/stepwise/host-staged tiers, runs the
+    best admitted tier (donating the caller's x once the sorted copy
+    exists), and ``last_fit_report`` lands in the record — per-tier
+    memory_analysis breakdowns for every probed shape, successes AND
+    failures, plus which tier actually solved it."""
     from keystone_tpu.solvers.weighted import BlockWeightedLeastSquaresEstimator
 
-    n, d, c = 131072, 8192, 256
-
-    @jax.jit
-    def make():
-        kx, ky = jax.random.split(jax.random.PRNGKey(11))
-        x = jax.random.normal(kx, (n, d), jnp.float32)
-        cls = jax.random.randint(ky, (n,), 0, c)
-        y = 2.0 * jax.nn.one_hot(cls, c, dtype=jnp.float32) - 1.0
-        return x, y
-
-    x, y = make()
-    x.block_until_ready()
-    est = BlockWeightedLeastSquaresEstimator(
-        4096, num_iter=1, lam=0.01, mixture_weight=0.25
-    )
-    captured = {}
-    orig = wsolver._fused_bwls_fit
-
-    def capture(*args, **kw):
-        captured["args"], captured["kw"] = args, kw
-        return orig(*args, **kw)
-
-    wsolver._fused_bwls_fit = capture
-    try:
-        m0 = est.fit(x, y)
-    finally:
-        wsolver._fused_bwls_fit = orig
-    float(sum(jnp.sum(b) for b in m0.xs))  # sync the warm fit
-
-    x_t = x * jnp.float32(1.0 + 1e-6)
-    float(jnp.sum(x_t[0]))
-    t0 = time.perf_counter()
-    m1 = est.fit(x_t, y)
-    float(sum(jnp.sum(b) for b in m1.xs))
-    wall = time.perf_counter() - t0
-
-    args, kw = captured["args"], captured["kw"]
-    compiled = orig.lower(*args, **kw).compile()
-    flops = bytes_accessed = None
-    mem = {}
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        flops = float(ca.get("flops", 0.0)) or None
-        bytes_accessed = float(ca.get("bytes accessed", 0.0)) or None
-    except Exception:
-        pass
-    try:
-        ma = compiled.memory_analysis()
-        mem = {
-            "argument_gb": round(ma.argument_size_in_bytes / 2**30, 2),
-            "temp_gb": round(ma.temp_size_in_bytes / 2**30, 2),
-            "output_gb": round(ma.output_size_in_bytes / 2**30, 2),
+    c = 256
+    shapes = [  # (n, d) descending footprint
+        (131072, 16384),  # 8.0 GB design matrix
+        (131072, 8192),   # 4.0 GB
+    ]
+    attempts = []
+    result = None
+    for n, d in shapes:
+        rec = {
+            "n": n, "d": d, "classes": c, "block_size": 4096,
+            "design_matrix_gb": round(n * d * 4 / 2**30, 2),
         }
-    except Exception:
-        pass
-    # One timed execution of the solve program alone (perturbed lam).
-    t0 = time.perf_counter()
-    out = compiled(*[
-        a * jnp.float32(1.000001) if i == 9 else a for i, a in enumerate(args)
-        if i < 11
-    ])
-    float(jnp.sum(out[0]))
-    solve_exec = time.perf_counter() - t0
-    return {
-        "n": n, "d": d, "classes": c, "block_size": 4096,
-        "design_matrix_gb": round(n * d * 4 / 2**30, 2),
-        "fit_wall_seconds": round(wall, 3),
-        "solve_exec_seconds": round(solve_exec, 3),
-        "flops": flops,
-        "bytes_accessed": bytes_accessed,
-        "flops_per_sec": round(flops / solve_exec, 3) if flops else None,
-        "memory_analysis": mem,
-    }
+        est = BlockWeightedLeastSquaresEstimator(
+            4096, num_iter=1, lam=0.01, mixture_weight=0.25
+        )
+        try:
+            key = jax.random.PRNGKey(11 + d % 13)
+
+            @jax.jit
+            def make(key=key, n=n, d=d):
+                kx, ky = jax.random.split(key)
+                x = jax.random.normal(kx, (n, d), jnp.float32)
+                cls = jax.random.randint(ky, (n,), 0, c)
+                y = 2.0 * jax.nn.one_hot(cls, c, dtype=jnp.float32) - 1.0
+                return x, y
+
+            x, y = make()
+            x.block_until_ready()
+            # donate=True: the fit frees this x/y once their sorted copies
+            # exist — the caller-side half of the 2x class-sort peak.
+            # The wall includes the fit's one-time preflight compiles.
+            t0 = time.perf_counter()
+            model = est.fit(x, y, donate=True)
+            float(sum(jnp.sum(b) for b in model.xs))  # scalar pull = sync
+            wall = time.perf_counter() - t0
+            rep = est.last_fit_report
+            result = {
+                **rec,
+                "fit_wall_seconds": round(wall, 3),
+                "examples_per_sec": round(n / wall, 1),
+                "solver": rep.record() if rep is not None else None,
+            }
+            model = None  # noqa: F841 — free before returning to the caller
+            break
+        except Exception as e:  # noqa: BLE001 — the boundary is data
+            rep = est.last_fit_report
+            attempts.append({
+                **rec,
+                "error": f"{type(e).__name__}: {e}"[:160],
+                "solver": rep.record() if rep is not None else None,
+            })
+            x = y = None  # free HBM before the next probe
+    if result is None:
+        return {"error": "no probed shape fit", "attempts": attempts}
+    result["attempts"] = attempts
+    return result
 
 
 def bench_decode(rng):

@@ -1,0 +1,464 @@
+"""HBM admission control: preflight memory planning + graceful degradation.
+
+VERDICT r5's top finding was that the flagship fused solvers discovered OOM
+as a bare ``RESOURCE_EXHAUSTED`` at execution time — a 4 GB design matrix
+failing on a 16 GB chip with nothing saying *whose* memory died.  KeystoneML
+never had this failure mode because Spark's block manager admitted or
+spilled every cached partition against a known executor budget; this module
+is that admission-control discipline rebuilt for a single-controller JAX
+stack:
+
+* :func:`hbm_budget` — the byte budget a fit may plan against:
+  ``KEYSTONE_HBM_BUDGET`` (testing / policy override) or the live device's
+  ``memory_stats()`` free bytes; ``None`` when neither is known (CPU
+  backends), in which case admission is skipped, never guessed.
+* :class:`MemoryPlan` / :func:`plan_program` — AOT-lower a candidate
+  program on ``jax.ShapeDtypeStruct``s (NO data is allocated to plan),
+  read ``compiled.memory_analysis()`` (argument/temp/output/alias bytes),
+  add the caller's accounting of persistent buffers the program's argument
+  list does not see (``extra_bytes``), and return admit/deny with the full
+  breakdown.  An OOM is thereby diagnosed *before* execution, with numbers.
+* :func:`run_ladder` — the graceful-degradation driver: an ordered list of
+  :class:`Tier`\\ s (e.g. fused one-program → stepwise per-block →
+  host-staged streaming) is walked with per-tier preflight; a denied tier
+  is skipped with its reason counted, an admitted tier that still dies with
+  ``RESOURCE_EXHAUSTED`` at runtime steps down exactly one tier instead of
+  failing the fit.  The last tier is the floor — it runs even if its own
+  preflight is pessimistic, because there is nothing below it.
+* :class:`FitReport` — the audit trail (per-tier plans, chosen tier,
+  denials, OOM retries) estimators expose as ``last_fit_report`` and the
+  bench emits verbatim, so the OOM boundary is measured, not guessed.
+
+Temp-size caveat: CPU backends report ``temp_size_in_bytes == 0``, which
+would make a fused program look cheaper than its own stepwise decomposition.
+Callers that know a program's true transient floor pass it as
+``min_temp_bytes``; the plan uses ``max(reported, analytic)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+from typing import Any, Callable, Sequence
+
+import jax
+
+from .resilience import counters
+
+_logger = logging.getLogger("keystone_tpu.memory")
+
+#: env var: byte budget override ("2G", "512M", "1.5T", or plain bytes).
+HBM_BUDGET_ENV = "KEYSTONE_HBM_BUDGET"
+
+_SUFFIX = {"": 1, "K": 2**10, "M": 2**20, "G": 2**30, "T": 2**40}
+
+
+def parse_bytes(spec: str | int | float) -> int:
+    """``"16G"`` / ``"512M"`` / ``"1.5GB"`` / ``4096`` -> bytes."""
+    if isinstance(spec, (int, float)):
+        return int(spec)
+    m = re.fullmatch(
+        r"\s*([0-9]+(?:\.[0-9]+)?)\s*([KMGT]?)I?B?\s*", str(spec).upper()
+    )
+    if not m:
+        raise ValueError(
+            f"cannot parse byte size {spec!r} (expected e.g. '16G', '512M', "
+            "'1.5GB', or a plain byte count)"
+        )
+    return int(float(m.group(1)) * _SUFFIX[m.group(2)])
+
+
+def fmt_bytes(b: int | float) -> str:
+    """Human-scaled byte count for log/reason strings ('3.25GB', '514KB')."""
+    b = float(b)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(b) < 1024 or unit == "GB":
+            return f"{b:.2f}{unit}" if unit != "B" else f"{int(b)}B"
+        b /= 1024
+    return f"{b:.2f}TB"  # pragma: no cover
+
+
+def budget_is_live() -> bool:
+    """True when :func:`hbm_budget` reads LIVE free bytes (device
+    ``memory_stats``) rather than the ``KEYSTONE_HBM_BUDGET`` capacity
+    override.  The distinction matters for admission: a live free-bytes
+    budget already excludes device-resident inputs, so their bytes must be
+    credited back out of a plan's total (``plan_program(resident_bytes=)``)
+    or a fit whose matrix is already on-chip double-counts it and degrades
+    needlessly; a capacity-style env budget must charge them."""
+    return not os.environ.get(HBM_BUDGET_ENV, "").strip()
+
+
+def hbm_budget(device=None) -> int | None:
+    """Bytes a program may plan against, or ``None`` when unknowable.
+
+    Priority: ``KEYSTONE_HBM_BUDGET`` env (tests force degradation tiers
+    with it; capacity semantics — resident inputs charge against it) > the
+    device's live ``memory_stats()`` free bytes (limit minus in-use — the
+    same numbers Spark's block manager admitted against; already-resident
+    inputs are credited via ``plan_program(resident_bytes=)``) > ``None``
+    (CPU and other backends without stats: admission is skipped, the
+    solver runs its first tier exactly as before this module existed).
+    """
+    raw = os.environ.get(HBM_BUDGET_ENV, "").strip()
+    if raw:
+        return parse_bytes(raw)
+    device = device if device is not None else jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — backends without stats
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    if not limit:
+        return None
+    return int(limit) - int(stats.get("bytes_in_use", 0))
+
+
+@dataclasses.dataclass
+class MemoryPlan:
+    """Admit/deny verdict for one candidate program, with the evidence."""
+
+    label: str
+    admitted: bool
+    reason: str
+    budget_bytes: int | None = None
+    argument_bytes: int = 0
+    temp_bytes: int = 0
+    output_bytes: int = 0
+    alias_bytes: int = 0
+    extra_bytes: int = 0  # persistent buffers outside the program's args
+    resident_bytes: int = 0  # of total, already allocated on device
+    total_bytes: int = 0
+    analyzed: bool = False  # False: no compile happened (no budget known)
+    error: str | None = None
+    compiled: Any = dataclasses.field(default=None, repr=False, compare=False)
+
+    def breakdown(self) -> dict:
+        """JSON-able record for bench artifacts (GB, 3 decimals)."""
+        gb = lambda b: round(b / 2**30, 3)  # noqa: E731
+        out = {
+            "admitted": self.admitted,
+            "analyzed": self.analyzed,
+            "argument_gb": gb(self.argument_bytes),
+            "temp_gb": gb(self.temp_bytes),
+            "output_gb": gb(self.output_bytes),
+            "alias_gb": gb(self.alias_bytes),
+            "extra_gb": gb(self.extra_bytes),
+            "resident_gb": gb(self.resident_bytes),
+            "total_gb": gb(self.total_bytes),
+            "budget_gb": gb(self.budget_bytes) if self.budget_bytes else None,
+            "reason": self.reason,
+        }
+        if self.error:
+            out["error"] = self.error[:200]
+        return out
+
+
+_UNSET = object()
+# (fn, arg signature) -> dict of analysis numbers + compiled object;
+# admission is re-evaluated against the CURRENT budget on every call, but the
+# AOT lower+compile (the expensive part) happens once per program signature.
+# Entries hold the compiled EXECUTABLE (so an admitted plan executes the very
+# program that was planned) — callers probing many throwaway shapes (the
+# at-scale bench) call clear_plan_cache() afterwards to release them.
+_plan_cache: dict = {}
+
+
+def clear_plan_cache() -> None:
+    """Drop every cached plan analysis AND its compiled executable.  Loaded
+    executables can reserve device program memory; probe-style callers
+    (bench_solve_at_scale walks five multi-GB shapes) clear the cache once
+    the boundary is measured so the reservations don't outlive the probe."""
+    _plan_cache.clear()
+
+
+def _cache_key(fn, args, kwargs):
+    sig = []
+    for a in (*args, *sorted(kwargs.items())):
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            sig.append(("arr", tuple(a.shape), str(a.dtype)))
+        else:
+            sig.append(("static", a))
+    return (id(fn), tuple(sig))
+
+
+def plan_program(
+    fn,
+    *args,
+    label: str = "program",
+    budget: int | None | object = _UNSET,
+    extra_bytes: int = 0,
+    min_temp_bytes: int = 0,
+    resident_bytes: int = 0,
+    require_analysis: bool = False,
+    **kwargs,
+) -> MemoryPlan:
+    """Preflight ``fn`` (a ``jax.jit``-wrapped callable) on ``args``.
+
+    ``args`` may be real arrays OR ``jax.ShapeDtypeStruct``s — planning
+    allocates nothing.  When a budget is known (or ``require_analysis``),
+    the program is AOT lowered+compiled (cached per signature; the returned
+    plan carries ``compiled`` so an admitted fused program executes the very
+    executable that was planned, not a recompile) and admission compares
+
+        argument + max(temp, min_temp_bytes) + output − alias + extra
+
+    against the budget.  ``resident_bytes`` declares how much of that total
+    is ALREADY allocated on device (e.g. a device-resident design matrix
+    among the arguments): a live free-bytes budget (:func:`budget_is_live`)
+    excludes those bytes from free, so they are credited back before the
+    comparison; a capacity-style ``KEYSTONE_HBM_BUDGET`` charges them.
+    With no budget and no ``require_analysis`` the plan is a zero-cost
+    pass-through: admitted, unanalyzed, reason recorded.  Denials are
+    counted under ``hbm_preflight_denied``.
+    """
+    if budget is _UNSET:
+        budget = hbm_budget()
+    if budget is None and not require_analysis:
+        return MemoryPlan(
+            label=label,
+            admitted=True,
+            reason=(
+                "no HBM budget known (no device memory_stats and "
+                f"{HBM_BUDGET_ENV} unset) — admission skipped"
+            ),
+        )
+
+    key = _cache_key(fn, args, kwargs)
+    cached = _plan_cache.get(key)
+    if cached is None:
+        try:
+            compiled = fn.lower(*args, **kwargs).compile()
+            ma = compiled.memory_analysis()
+            cached = {
+                "argument": int(ma.argument_size_in_bytes),
+                "temp": int(ma.temp_size_in_bytes),
+                "output": int(ma.output_size_in_bytes),
+                "alias": int(ma.alias_size_in_bytes),
+                "compiled": compiled,
+                "error": None,
+            }
+            # Only SUCCESSFUL analyses are cached: a compile failure can be
+            # transient (program-memory pressure from live buffers), and
+            # caching it would deny this tier for the rest of the process.
+            _plan_cache[key] = cached
+        except Exception as e:  # noqa: BLE001 — a compile OOM IS an answer
+            cached = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    if cached["error"] is not None:
+        plan = MemoryPlan(
+            label=label,
+            admitted=False,
+            reason=f"lower/compile failed: {cached['error'][:120]}",
+            budget_bytes=budget,
+            analyzed=False,
+            error=cached["error"],
+        )
+        counters.record("hbm_preflight_denied", f"{label}: {plan.reason}")
+        return plan
+
+    temp = max(cached["temp"], min_temp_bytes)
+    total = (
+        cached["argument"] + temp + cached["output"] - cached["alias"]
+        + extra_bytes
+    )
+    credit = resident_bytes if budget_is_live() else 0
+    admitted = budget is None or total - credit <= budget
+    h = fmt_bytes
+    reason = (
+        f"args {h(cached['argument'])} + temp {h(temp)} + "
+        f"out {h(cached['output'])} - alias {h(cached['alias'])} "
+        f"+ extra {h(extra_bytes)} = {h(total)}"
+        + (f" (- {h(credit)} already resident)" if credit else "")
+        + " vs "
+        + (f"budget {h(budget)}" if budget is not None else "no budget")
+    )
+    plan = MemoryPlan(
+        label=label,
+        admitted=admitted,
+        reason=("fits: " if admitted else "DENIED: ") + reason,
+        budget_bytes=budget,
+        argument_bytes=cached["argument"],
+        temp_bytes=temp,
+        output_bytes=cached["output"],
+        alias_bytes=cached["alias"],
+        extra_bytes=extra_bytes,
+        resident_bytes=resident_bytes,
+        total_bytes=total,
+        analyzed=True,
+        compiled=cached["compiled"],
+    )
+    if not admitted:
+        counters.record("hbm_preflight_denied", f"{label}: {reason}")
+    return plan
+
+
+# -- OOM detection / recovery -------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+class LadderSourceLost(RuntimeError):
+    """A ladder tier cannot run because its data source was donated away
+    (``fit(donate=True)`` consumed the caller's buffers and a later tier
+    has nothing to rebuild from).  Deliberately NOT an OOM: the ladder must
+    surface it, not retry through it."""
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """True for XLA's device-memory exhaustion (``XlaRuntimeError`` carrying
+    RESOURCE_EXHAUSTED / out-of-memory text) — the ONLY failure the
+    degradation ladder retries; everything else — including the ladder's
+    own :class:`LadderSourceLost` guard — propagates unchanged."""
+    if isinstance(e, LadderSourceLost):
+        return False
+    if not isinstance(e, (RuntimeError, MemoryError)):
+        return False
+    msg = str(e)
+    return isinstance(e, MemoryError) or any(m in msg for m in _OOM_MARKERS)
+
+
+def free_buffers(*arrays) -> None:
+    """Best-effort immediate release of device buffers (OOM recovery frees
+    the failed tier's live arrays before retrying a cheaper tier, rather
+    than waiting on the GC)."""
+    for a in arrays:
+        if isinstance(a, jax.Array):
+            try:
+                if not a.is_deleted():
+                    a.delete()
+            except Exception:  # noqa: BLE001 — freeing is advisory
+                pass
+
+
+def array_bytes(*shaped) -> int:
+    """Σ nbytes of arrays/ShapeDtypeStructs (resident-set accounting for
+    ``plan_program(extra_bytes=...)``)."""
+    import numpy as np
+
+    total = 0
+    for s in shaped:
+        if s is None:
+            continue
+        n = 1
+        for dim in s.shape:
+            n *= int(dim)
+        total += n * np.dtype(s.dtype).itemsize
+    return total
+
+
+# -- the degradation ladder ---------------------------------------------------
+
+
+@dataclasses.dataclass
+class Tier:
+    """One rung: ``plan`` is lazy (called at selection time), ``run`` gets
+    the plan back so an admitted fused tier can execute ``plan.compiled``."""
+
+    name: str
+    plan: Callable[[], MemoryPlan]
+    run: Callable[[MemoryPlan], Any]
+
+
+@dataclasses.dataclass
+class FitReport:
+    """Audit trail of one laddered fit (``estimator.last_fit_report``)."""
+
+    label: str = ""
+    budget_bytes: int | None = None
+    plans: dict = dataclasses.field(default_factory=dict)
+    chosen: str | None = None
+    denials: list = dataclasses.field(default_factory=list)
+    oom_retries: list = dataclasses.field(default_factory=list)
+
+    def record(self) -> dict:
+        """JSON-able form for bench artifacts."""
+        return {
+            "chosen_tier": self.chosen,
+            "budget_gb": (
+                round(self.budget_bytes / 2**30, 3) if self.budget_bytes else None
+            ),
+            "denials": list(self.denials),
+            "oom_retries": list(self.oom_retries),
+            "tiers": {k: p.breakdown() for k, p in self.plans.items()},
+        }
+
+    def summary(self) -> str:
+        s = f"{self.label}: tier={self.chosen}"
+        if self.denials:
+            s += f", denied={self.denials}"
+        if self.oom_retries:
+            s += f", oom_retries={self.oom_retries}"
+        return s
+
+    def degraded(self) -> bool:
+        return bool(self.denials or self.oom_retries)
+
+
+def run_ladder(label: str, tiers: Sequence[Tier], report: FitReport):
+    """Walk ``tiers`` best-first: preflight each LAZILY (a tier is only
+    planned — and its program only compiled — once every better tier has
+    been denied or OOMed, so the common fused-admitted fit pays for exactly
+    one plan), run the first admitted one, and on a runtime
+    ``RESOURCE_EXHAUSTED`` step down exactly one tier (the tier's ``run``
+    frees its own buffers on the way out; anything it leaked is
+    best-effort-freed by the next tier's builder).  The final tier is the
+    floor: it runs even when its preflight is a deny — with a warning —
+    because failing is the only thing below it.  Every CONSIDERED tier's
+    plan lands in ``report`` so the decision is auditable afterwards.
+    """
+    report.label = label
+    last_oom: BaseException | None = None
+    for i, tier in enumerate(tiers):
+        floor = i == len(tiers) - 1
+        plan = tier.plan()
+        report.plans[tier.name] = plan
+        if plan.budget_bytes is not None:
+            report.budget_bytes = plan.budget_bytes
+        if not plan.admitted and not floor:
+            report.denials.append(tier.name)
+            _logger.info("%s: %s denied by preflight — %s", label, tier.name, plan.reason)
+            continue
+        if not plan.admitted and floor:
+            _logger.warning(
+                "%s: floor tier %s denied by preflight (%s) but nothing is "
+                "below it — attempting anyway",
+                label, tier.name, plan.reason,
+            )
+        try:
+            out = tier.run(plan)
+        except Exception as e:  # noqa: BLE001 — only OOM is retried
+            if not is_oom_error(e) or floor:
+                raise
+            report.oom_retries.append(tier.name)
+            counters.record(
+                "solver_oom_retry",
+                f"{label}/{tier.name}: RESOURCE_EXHAUSTED at runtime "
+                f"(preflight said: {plan.reason}) — stepping down one tier",
+            )
+            last_oom = e
+            continue
+        report.chosen = tier.name
+        if report.degraded() or tier.name != tiers[0].name:
+            counters.record("solver_tier_degraded", report.summary())
+        _logger.info("%s: running tier=%s (%s)", label, tier.name, plan.reason)
+        return out
+    # Unreachable in practice (the floor either returns or raises), but be
+    # explicit if a caller builds a ladder whose floor denied AND raised.
+    raise RuntimeError(
+        f"{label}: every ladder tier failed"
+    ) from last_oom
+
+
+def log_fit_report(est, logger=None, label: str = "") -> None:
+    """Workload fit-path hook: surface which tier a solve actually ran on
+    (one INFO line; degradations are already counted by the ladder)."""
+    rep = getattr(est, "last_fit_report", None)
+    if rep is None:
+        return
+    lg = logger or _logger
+    lg.info("%s%s", f"{label}: " if label else "", rep.summary())

@@ -43,9 +43,11 @@ except ImportError:  # pre-0.5 jax exports it under experimental only
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import memory as kmem
 from ..core.pipeline import LabelEstimator
+from ..core.resilience import counters
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, current_mesh
-from .block import BlockLinearMapper, _blocked_design_matrix
+from .block import BlockLinearMapper, _blocked_design_matrix, _design_matrix_owned
 
 # Per-row byte budget for the column-chunked device gather in the class
 # shuffle: each chunk transiently materializes [p_tot, chunk_bytes] un-sharded
@@ -233,11 +235,7 @@ def _class_solves(
     return dws.reshape(n_chunks * chunk, d)[:c_total].T  # [d, C]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_iter", "n_max", "chunk", "num_classes", "widths", "mesh"),
-)
-def _fused_bwls_fit(
+def _fused_bwls_impl(
     x, labels_sorted, valid, seg_ids, starts, counts, counts_f,
     joint_label_mean, nvalid, lam, w,
     num_iter: int, n_max: int, chunk: int, num_classes: int, widths, mesh,
@@ -333,6 +331,130 @@ def _fused_bwls_fit(
     return models, intercept
 
 
+_BWLS_STATICS = ("num_iter", "n_max", "chunk", "num_classes", "widths", "mesh")
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_bwls_fit_variant(donate_argnums: tuple = ()):
+    """jit of the fused BWLS solve with a chosen donation set.  ``(0, 1)``
+    donates the sorted design matrix and sorted labels — both are copies
+    the fit itself created in ``sort_pad``, never caller-visible arrays, so
+    the single-device fit donates them unconditionally and XLA reuses their
+    HBM for the residual/block temps."""
+    return jax.jit(
+        _fused_bwls_impl,
+        static_argnames=_BWLS_STATICS,
+        donate_argnums=donate_argnums,
+    )
+
+
+#: Historical non-donating entry point (the mesh path and AOT benches).
+_fused_bwls_fit = _fused_bwls_fit_variant(())
+
+
+def _execute_fused_bwls(plan, args, statics):
+    """Dispatch the fused BWLS program: the planned AOT executable when
+    admission ran, else the donating jitted variant.  Module level so
+    benches capture the exact solve arguments here and the fault harness
+    injects RESOURCE_EXHAUSTED to exercise the ladder step-down."""
+    if plan is not None and plan.compiled is not None:
+        return plan.compiled(*args)
+    return _fused_bwls_fit_variant((0, 1))(*args, *statics)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_cols(out, g, c0):
+    """One column-chunk landing in the preallocated gather output.  The
+    donated ``out`` buffer is updated in place (TPU aliases it), so the
+    chunked sort_pad gather peaks at source + output + ONE chunk — the
+    round-5 form accumulated every chunk in a list and concatenated,
+    transiently holding ~3x the design matrix (ADVICE r5)."""
+    return jax.lax.dynamic_update_slice(out, g, (jnp.int32(0), c0))
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes",))
+def _bwls_block_stats(xb, seg_ids, counts_f, n, w, pad_diag_i, num_classes: int):
+    """Population/per-class statistics of ONE block — the per-block body of
+    the fused program's stats scan, exposed as its own program for the
+    stepwise/host-staged ladder tiers (identical math, one dispatch per
+    block)."""
+    pop_mean = jnp.sum(xb, axis=0) / n
+    pop_cov = xb.T @ xb / n - jnp.outer(pop_mean, pop_mean) + jnp.diag(pad_diag_i)
+    class_means = _class_sums(xb, seg_ids, num_classes) / counts_f[:, None]
+    return pop_cov, pop_mean, w * class_means + (1.0 - w) * pop_mean
+
+
+@jax.jit
+def _bwls_block_xtr(xb, res, n):
+    return xb.T @ res / n
+
+
+@jax.jit
+def _bwls_block_apply(xb, res, model, dw):
+    return model + dw, res - xb @ dw
+
+
+def _stepwise_bwls_fit(
+    get_block, labels_sorted, valid, seg_ids, starts, counts, counts_f,
+    joint_label_mean, nvalid, lam, w,
+    num_iter: int, n_max: int, chunk: int, num_classes: int, widths,
+):
+    """The BWLS solve driven from the host one block at a time — the
+    stepwise/host-staged rungs of the degradation ladder.  ``get_block(i)``
+    returns block i as a device [P, bs] array: a device-side slice of the
+    sorted design matrix (stepwise — bounds per-dispatch temps) or an H2D
+    upload from a host-resident sorted matrix (host-staged — the design
+    matrix never fully occupies HBM; peak device residency is one block +
+    the residual + the per-block statistics caches).  Statistics are
+    computed once and cached across passes, and the update order matches
+    ``_fused_bwls_fit`` exactly, so results are numerically identical.
+    """
+    bs = max(widths)
+    nb = len(widths)
+    dtype = labels_sorted.dtype
+    n = jnp.asarray(nvalid, dtype)
+    w_arr = jnp.asarray(w, dtype)
+    lam_arr = jnp.asarray(lam, dtype)
+
+    res = (labels_sorted - joint_label_mean) * valid
+    rmean = _residual_class_means(res, seg_ids, counts_f, num_classes)
+    pad_diag = np.stack(
+        [(np.arange(bs) >= wd).astype(np.float64) for wd in widths]
+    )
+
+    stats = []
+    for i in range(nb):
+        xb = get_block(i)
+        stats.append(
+            _bwls_block_stats(
+                xb, seg_ids, counts_f, n, w_arr,
+                jnp.asarray(pad_diag[i], dtype), num_classes,
+            )
+        )
+        del xb
+
+    models = [jnp.zeros((bs, num_classes), dtype) for _ in range(nb)]
+    for _ in range(num_iter):
+        for i in range(nb):
+            xb = get_block(i)
+            pop_cov, pop_mean, jm = stats[i]
+            pop_xtr = _bwls_block_xtr(xb, res, n)
+            dw = _class_solves(
+                xb, res, starts, counts, pop_cov, pop_mean, pop_xtr,
+                jm, rmean, models[i], lam_arr, w_arr, n_max, chunk, None,
+            )
+            models[i], res = _bwls_block_apply(xb, res, models[i], dw)
+            rmean = _residual_class_means(res, seg_ids, counts_f, num_classes)
+            del xb
+
+    joint_means_all = jnp.stack([s[2] for s in stats])
+    models_st = jnp.stack(models)
+    intercept = joint_label_mean - jnp.einsum(
+        "bcd,bdc->c", joint_means_all, models_st
+    )
+    return models_st, intercept
+
+
 @functools.partial(jax.jit, static_argnames=("num_classes",))
 def _class_sums(x_pad, seg_ids, num_classes: int):
     """Per-class row sums of a (sorted, padded) block via segment sum.
@@ -377,6 +499,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         self.mixture_weight = mixture_weight
         self.class_chunk = class_chunk
         self.mesh = mesh
+        #: core.memory.FitReport of the most recent fit (tier plans, chosen
+        #: tier, denials, OOM retries) — the bench emits it verbatim.
+        self.last_fit_report = None
 
     def fit(
         self,
@@ -384,15 +509,28 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         labels,
         num_features: int | None = None,
         nvalid: int | None = None,
+        donate: bool | None = None,
     ) -> BlockLinearMapper:
         """``features``/``labels`` may be host arrays OR device-resident
         (row-sharded) ``jax.Array``s — the full design matrix is never
         materialized on host.  ``nvalid``: true global row count when the
         inputs carry zero pad rows from ``padded_shard_rows``; pad rows are
-        excluded from the class grouping."""
+        excluded from the class grouping.
+
+        Memory resilience (single-device fits): the solve runs the
+        degradation ladder fused one-program → stepwise per-block →
+        host-staged block streaming, each tier preflighted against the HBM
+        budget (core.memory; ``KEYSTONE_HBM_BUDGET`` overrides) and a
+        runtime RESOURCE_EXHAUSTED steps down one tier.  The fused program
+        always donates the SORTED design-matrix/label copies (they are
+        fit-private).  ``donate=True`` additionally frees the CALLER's
+        device-resident inputs as soon as their sorted copies exist —
+        halving the peak across the class-sort gather — at the price that
+        an exec-level OOM can no longer rebuild them for the step-down.
+        The decision trail is ``self.last_fit_report``."""
         mesh = self.mesh if self.mesh is not None else current_mesh()
-        n = nvalid if nvalid is not None else np.shape(labels)[0]
-        n_classes = np.shape(labels)[1]
+        n = nvalid if nvalid is not None else int(np.shape(labels)[0])
+        n_classes = int(np.shape(labels)[1])
         # Class of each valid row: device argmax for device labels, so only
         # the [n] int vector crosses to host (round 2 pulled the whole
         # design matrix); plain numpy argmax for host labels.
@@ -478,9 +616,28 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 plan = regroup_plans[n_src]
                 if plan.usable:  # else: skew guard — chunked fallback below
                     return plan.apply(mesh, jax.device_put(x, row_shard))
+                # A survivable degradation, counted so operators (and the
+                # multichip dryrun) can see which regroup path actually ran.
+                counters.record(
+                    "bwls_regroup_skew_fallback",
+                    f"d*m_pad {plan.d * plan.m_pad} > 2*rows_out "
+                    f"{2 * plan.rows_out}: bucket padding beyond 2x optimal "
+                    "— taking the chunked-gather fallback",
+                )
 
             chunk_cols = max(1, _GATHER_COL_CHUNK // max(1, x.itemsize))
-            outs = []
+            if x.shape[1] <= chunk_cols:
+                g = jnp.take(x, gather_idx, axis=0, mode="fill", fill_value=0)
+                g = g * valid.astype(x.dtype)
+                return g if row_shard is None else jax.device_put(g, row_shard)
+            # Chunks land in a PREALLOCATED output via a donating
+            # dynamic-update-slice, so peak HBM is source + output + one
+            # chunk (~2x the design matrix).  The round-5 form accumulated
+            # all chunks in a list and concatenated — source + chunks +
+            # concat output, ~3x transient (ADVICE r5 medium).
+            out = jnp.zeros((p_tot, x.shape[1]), x.dtype)
+            if row_shard is not None:
+                out = jax.device_put(out, row_shard)
             for c0 in range(0, x.shape[1], chunk_cols):
                 sl = jax.lax.slice_in_dim(
                     x, c0, min(c0 + chunk_cols, x.shape[1]), axis=1
@@ -491,10 +648,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     # Reshard each slab as it lands so at most one
                     # unsharded chunk is transient at a time.
                     g = jax.device_put(g, row_shard)
-                outs.append(g)
-            return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
-
-        x = sort_pad(x)
+                out = _scatter_cols(out, g, jnp.int32(c0))
+            return out
 
         counts = jnp.asarray(counts_np)
         starts = jnp.asarray(starts_np)
@@ -508,11 +663,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         joint_label_mean = jnp.asarray(
             2.0 * w + 2.0 * (1.0 - w) * counts_np / n - 1.0, dtype
         )
-
-        if isinstance(labels, jax.Array):
-            labels_sorted = sort_pad(labels.astype(dtype))
-        else:
-            labels_sorted = sort_pad(np.asarray(labels, dtype))
+        valid_d = valid.astype(dtype)
 
         chunk = max(1, min(self.class_chunk, n_classes))
         if mesh is not None:
@@ -522,27 +673,207 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             m_size = mesh.shape[MODEL_AXIS]
             chunk = -(-chunk // m_size) * m_size
 
-        # The ENTIRE solve is one compiled program; the dispatches above
-        # (one regroup for the design matrix + one for labels) are the only
-        # others in a fit.
-        models_st, b = _fused_bwls_fit(
-            x,
-            labels_sorted,
-            valid.astype(dtype),
-            seg_ids,
-            starts,
-            counts,
-            counts_f,
-            joint_label_mean,
-            jnp.asarray(n),
-            jnp.asarray(self.lam, dtype),
-            jnp.asarray(w, dtype),
-            self.num_iter,
-            n_max,
-            chunk,
-            n_classes,
-            widths,
-            mesh,
-        )
+        def sort_labels():
+            if isinstance(labels, jax.Array):
+                return sort_pad(labels.astype(dtype))
+            return sort_pad(np.asarray(labels, dtype))
+
+        if mesh is not None:
+            # Multi-chip path: per-chip admission of a GSPMD program is not
+            # modeled; the sharded fused program runs directly, as before.
+            self.last_fit_report = kmem.FitReport(
+                label="bwls_fit", chosen="fused[mesh]"
+            )
+            models_st, b = _fused_bwls_fit(
+                sort_pad(x), sort_labels(), valid_d, seg_ids, starts, counts,
+                counts_f, joint_label_mean, jnp.asarray(n),
+                jnp.asarray(self.lam, dtype), jnp.asarray(w, dtype),
+                self.num_iter, n_max, chunk, n_classes, widths, mesh,
+            )
+        else:
+            models_st, b = self._fit_ladder(
+                features, x, labels, sort_pad, sort_labels, order, valid_d,
+                seg_ids, starts, counts, counts_f, joint_label_mean, n, n_max,
+                chunk, n_classes, widths, p_tot, dtype, donate,
+            )
         model_list = [models_st[i, :wd] for i, wd in enumerate(widths)]
         return BlockLinearMapper(model_list, self.block_size, b)
+
+    def _fit_ladder(
+        self, features, x, labels, sort_pad, sort_labels, order, valid_d,
+        seg_ids, starts, counts, counts_f, joint_label_mean, n, n_max, chunk,
+        n_classes, widths, p_tot, dtype, donate,
+    ):
+        """Single-device BWLS through the degradation ladder (preflight
+        admission per tier; runtime RESOURCE_EXHAUSTED steps down one tier).
+
+        The SORTED design matrix / labels are fit-private copies, so the
+        fused program always donates them; ``donate=True`` additionally
+        frees the caller's device inputs once sorted copies exist."""
+        bs, nb = max(widths), len(widths)
+        d_tot = nb * bs
+        it = np.dtype(dtype).itemsize
+        xdt = jax.dtypes.canonicalize_dtype(x.dtype)
+        budget = kmem.hbm_budget()
+        donate_input = bool(donate)
+
+        lam_arr = jnp.asarray(self.lam, dtype)
+        w_arr = jnp.asarray(self.mixture_weight, dtype)
+        nv_arr = jnp.asarray(n, jnp.int32)
+        statics = (self.num_iter, n_max, chunk, n_classes, widths, None)
+
+        sds = jax.ShapeDtypeStruct
+        i32 = jnp.int32
+        x_s = sds((p_tot, d_tot), xdt)
+        y_s = sds((p_tot, n_classes), dtype)
+        v_s = sds((p_tot, 1), dtype)
+        seg_s = sds((p_tot,), i32)
+        c_i32 = sds((n_classes,), i32)
+        c_f = sds((n_classes,), dtype)
+        sc_s, nv_s = sds((), dtype), sds((), i32)
+        xb_s = sds((p_tot, bs), xdt)
+        cov_s, mean_s = sds((bs, bs), dtype), sds((bs,), dtype)
+        xtr_s, jm_s = sds((bs, n_classes), dtype), sds((n_classes, bs), dtype)
+        m_s = sds((bs, n_classes), dtype)
+
+        # Resident-set accounting the per-program argument lists do not
+        # see: per-block statistics caches, the models stack, the sorted
+        # labels, and (unless donated) the caller's device inputs.
+        stats_bytes = it * nb * (bs * bs + bs + n_classes * bs)
+        models_bytes = it * nb * bs * n_classes
+        labels_bytes = it * p_tot * n_classes
+        # Device-resident caller inputs stay alive at least through the
+        # class-sort gather (source + sorted output coexist) even under
+        # donate=True, so they count against every tier's charged total —
+        # including host-staged, which pulls the source to RAM but cannot
+        # free a non-donated caller buffer.  When the budget is LIVE free
+        # bytes they are credited back (free already excludes them).
+        src_bytes = (
+            (x.nbytes if isinstance(x, jax.Array) else 0)
+            + (labels.nbytes if isinstance(labels, jax.Array) else 0)
+        )
+        # Analytic transient floor of the fused program (CPU backends
+        # report temp 0): two residual carries, one block slice, the stats
+        # stacks, the models carry, and the per-chunk class-solve slab.
+        fused_floor = it * (
+            2 * p_tot * n_classes + p_tot * bs + chunk * n_max * bs
+        ) + stats_bytes + models_bytes
+        slab_floor = it * chunk * n_max * bs
+
+        def plan_fused():
+            return kmem.plan_program(
+                _fused_bwls_fit_variant((0, 1)),
+                x_s, y_s, v_s, seg_s, c_i32, c_i32, c_f, c_f, nv_s, sc_s,
+                sc_s, *statics,
+                label="bwls_fused", budget=budget,
+                min_temp_bytes=fused_floor, extra_bytes=src_bytes,
+                resident_bytes=src_bytes,
+            )
+
+        def plan_stepwise():
+            return kmem.plan_program(
+                _class_solves, xb_s, y_s, c_i32, c_i32, cov_s, mean_s,
+                xtr_s, jm_s, c_f, m_s, sc_s, sc_s, n_max, chunk, None,
+                label="bwls_stepwise", budget=budget,
+                min_temp_bytes=slab_floor,
+                extra_bytes=(
+                    it * p_tot * d_tot  # the sorted design matrix
+                    + labels_bytes + stats_bytes + models_bytes + src_bytes
+                ),
+                resident_bytes=src_bytes,
+            )
+
+        def plan_host():
+            return kmem.plan_program(
+                _class_solves, xb_s, y_s, c_i32, c_i32, cov_s, mean_s,
+                xtr_s, jm_s, c_f, m_s, sc_s, sc_s, n_max, chunk, None,
+                label="bwls_host_staged", budget=budget,
+                min_temp_bytes=slab_floor,
+                extra_bytes=(
+                    labels_bytes + stats_bytes + models_bytes + src_bytes
+                ),
+                resident_bytes=src_bytes,
+            )
+
+        def src_x():
+            if isinstance(x, jax.Array) and x.is_deleted():
+                raise kmem.LadderSourceLost(
+                    "BWLS design matrix was donated (donate=True) and is "
+                    "gone — cannot step the ladder down; refit with "
+                    "donate=False to keep OOM recovery possible"
+                )
+            return x
+
+        def free_sources():
+            if donate_input:
+                kmem.free_buffers(
+                    x if isinstance(x, jax.Array) else None,
+                    labels if isinstance(labels, jax.Array) else None,
+                )
+
+        def sorted_device_inputs():
+            xs = sort_pad(src_x())
+            ls = sort_labels()
+            free_sources()
+            return xs, ls
+
+        def run_fused(plan):
+            xs, ls = sorted_device_inputs()
+            args = (xs, ls, valid_d, seg_ids, starts, counts, counts_f,
+                    joint_label_mean, nv_arr, lam_arr, w_arr)
+            del xs, ls  # the args tuple holds the only refs; donation eats them
+            return _execute_fused_bwls(plan, args, statics)
+
+        def run_stepwise(plan):
+            xs, ls = sorted_device_inputs()
+
+            def get_block(i):
+                return jax.lax.slice_in_dim(xs, i * bs, (i + 1) * bs, axis=1)
+
+            return _stepwise_bwls_fit(
+                get_block, ls, valid_d, seg_ids, starts, counts, counts_f,
+                joint_label_mean, n, self.lam, self.mixture_weight,
+                self.num_iter, n_max, chunk, n_classes, widths,
+            )
+
+        def run_host(plan):
+            xh = src_x()
+            x_np = (
+                np.asarray(jax.device_get(xh))
+                if isinstance(xh, jax.Array) else np.asarray(xh)
+            )
+            if isinstance(xh, jax.Array) and _design_matrix_owned(xh, features):
+                # Fit-owned device copy (concat/pad product): once pulled to
+                # host it must not keep the full matrix resident in HBM —
+                # that residency is exactly what this tier exists to avoid.
+                kmem.free_buffers(xh)
+            ls = sort_labels()
+            free_sources()
+            # Host-side class sort + zero tail: the device never holds more
+            # than one [P, bs] block of the design matrix.
+            x_sorted_h = np.zeros((p_tot, x_np.shape[1]), x_np.dtype)
+            x_sorted_h[:n] = x_np[order]
+            del x_np
+
+            def get_block(i):
+                return jnp.asarray(
+                    np.ascontiguousarray(x_sorted_h[:, i * bs : (i + 1) * bs])
+                )
+
+            return _stepwise_bwls_fit(
+                get_block, ls, valid_d, seg_ids, starts, counts, counts_f,
+                joint_label_mean, n, self.lam, self.mixture_weight,
+                self.num_iter, n_max, chunk, n_classes, widths,
+            )
+
+        report = kmem.FitReport(label="bwls_fit", budget_bytes=budget)
+        self.last_fit_report = report
+        return kmem.run_ladder(
+            "bwls_fit",
+            [
+                kmem.Tier("fused", plan_fused, run_fused),
+                kmem.Tier("stepwise", plan_stepwise, run_stepwise),
+                kmem.Tier("host_staged", plan_host, run_host),
+            ],
+            report,
+        )

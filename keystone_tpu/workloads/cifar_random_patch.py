@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.logging import Logging, configure_logging
+from ..core.memory import log_fit_report
 from ..core.pipeline import Pipeline
 from ..evaluation.multiclass import MulticlassClassifierEvaluator
 from ..loaders.cifar import LabeledImageBatch, cifar_loader
@@ -226,9 +227,9 @@ def run(
     train_features = scaler(train_conv)
 
     labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
-    model = BlockLeastSquaresEstimator(4096, 1, conf.lam or 0.0, mesh=mesh).fit(
-        train_features, labels
-    )
+    solver = BlockLeastSquaresEstimator(4096, 1, conf.lam or 0.0, mesh=mesh)
+    model = solver.fit(train_features, labels)
+    log_fit_report(solver, label="cifar random-patch solve")
 
     def predict(features):
         return MaxClassifier()(model(features))

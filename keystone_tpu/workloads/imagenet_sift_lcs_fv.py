@@ -21,6 +21,7 @@ import numpy as np
 
 from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
 from ..core.logging import Logging, configure_logging
+from ..core.memory import log_fit_report
 from ..core.resilience import assert_all_finite
 from ..loaders.image_loaders import LabeledImages, imagenet_loader
 from ..ops.lcs import LCSExtractor
@@ -255,9 +256,14 @@ def run(
         labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
 
         # 2·2·descDim·vocabSize features (:186-188)
-        model = BlockWeightedLeastSquaresEstimator(
+        solver = BlockWeightedLeastSquaresEstimator(
             4096, 1, conf.lam, conf.mixture_weight, mesh=mesh
-        ).fit(train_features, labels, num_features=2 * 2 * conf.desc_dim * conf.vocab_size)
+        )
+        model = solver.fit(
+            train_features, labels,
+            num_features=2 * 2 * conf.desc_dim * conf.vocab_size,
+        )
+        log_fit_report(solver, label="ImageNet weighted block solve")
         assert_all_finite(model, "ImageNet weighted block solve")
 
         if conf.pipeline_file is not None:

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.logging import Logging, configure_logging
+from ..core.memory import log_fit_report
 from ..core.pipeline import Pipeline
 from ..evaluation.multiclass import MulticlassClassifierEvaluator
 from ..loaders.csv_loader import LabeledData, csv_data_loader
@@ -97,9 +98,11 @@ def run(
         for chains in batch_featurizer
     ]
 
-    model = BlockLeastSquaresEstimator(
+    solver = BlockLeastSquaresEstimator(
         conf.block_size, 1, conf.lam or 0.0, mesh=mesh
-    ).fit(training_batches, labels, nvalid=nvalid)
+    )
+    model = solver.fit(training_batches, labels, nvalid=nvalid)
+    log_fit_report(solver, label="mnist random-fft solve")
 
     test_batches = [
         ZipVectors.apply([chain(test_data) for chain in chains])

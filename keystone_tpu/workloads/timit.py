@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.logging import Logging, configure_logging
+from ..core.memory import log_fit_report
 from ..evaluation.multiclass import MulticlassClassifierEvaluator
 from ..loaders.timit import TIMIT_DIMENSION, TIMIT_NUM_CLASSES, TimitFeaturesData, timit_features_loader
 from ..ops.stats import CosineRandomFeatures, StandardScaler
@@ -99,9 +100,11 @@ def run(conf: TimitConfig, data: TimitFeaturesData, mesh=None) -> dict:
 
     test_batches = [f(test_data) for f in batch_featurizer]
 
-    model = BlockLeastSquaresEstimator(
+    solver = BlockLeastSquaresEstimator(
         conf.num_cosine_features, conf.num_epochs, conf.lam, mesh=mesh
-    ).fit(training_batches, labels, nvalid=nvalid)
+    )
+    model = solver.fit(training_batches, labels, nvalid=nvalid)
+    log_fit_report(solver, label="timit cosine solve")
 
     results: dict = {}
 

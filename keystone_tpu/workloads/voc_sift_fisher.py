@@ -21,6 +21,7 @@ import numpy as np
 
 from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
 from ..core.logging import Logging, configure_logging
+from ..core.memory import log_fit_report
 from ..core.resilience import assert_all_finite
 from ..evaluation.map import MeanAveragePrecisionEvaluator
 from ..loaders.image_loaders import VOC_NUM_CLASSES, MultiLabeledImages, voc_loader
@@ -166,9 +167,11 @@ def run(
             state_path = bcd_checkpoint_path(conf.solve_checkpoint)
             if os.path.exists(state_path):
                 solve_kwargs["resume_from"] = conf.solve_checkpoint
-        model = BlockLeastSquaresEstimator(4096, 1, conf.lam, mesh=mesh).fit(
+        solver = BlockLeastSquaresEstimator(4096, 1, conf.lam, mesh=mesh)
+        model = solver.fit(
             train_features, train_labels, num_features=feat_dim, **solve_kwargs
         )
+        log_fit_report(solver, label="VOC SIFT-Fisher solve")
         assert_all_finite(model, "VOC block least-squares fit")
         if state_path is not None and os.path.exists(state_path):
             # The per-block state is a RESUME artifact, not a model cache:

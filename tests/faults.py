@@ -17,6 +17,11 @@ our JAX port has to earn that hardness on purpose.  Three fault families:
   ``rank_deficient_gram`` builds a gram whose unregularized Cholesky is
   guaranteed to fail — exercising the solver jitter-retry and the
   ``assert_all_finite`` fit guards.
+* **device memory exhaustion**: ``resource_exhausted_error`` builds the
+  exact exception XLA raises on HBM OOM (``XlaRuntimeError`` carrying
+  RESOURCE_EXHAUSTED); ``oom_faults`` patches a callable to die with it
+  for the first N calls — exercising the solvers' degradation-ladder
+  step-down (core.memory.run_ladder) without needing a real OOM.
 """
 
 from __future__ import annotations
@@ -103,17 +108,62 @@ def flaky(fn, failures: int, exc: type[BaseException] = OSError, message: str = 
 
 
 @contextlib.contextmanager
-def transient_faults(obj, attr: str, failures: int, exc: type[BaseException] = OSError):
+def transient_faults(
+    obj,
+    attr: str,
+    failures: int,
+    exc: type[BaseException] = OSError,
+    message: str = "injected transient fault",
+):
     """Patch ``obj.attr`` with a :func:`flaky` wrapper for the duration of
     the block — e.g. ``transient_faults(image_loaders.tarfile, "open", 2)``
     makes the next two tar opens fail with OSError."""
     original = getattr(obj, attr)
-    wrapper = flaky(original, failures, exc)
+    wrapper = flaky(original, failures, exc, message)
     setattr(obj, attr, wrapper)
     try:
         yield wrapper
     finally:
         setattr(obj, attr, original)
+
+
+def xla_runtime_error_type() -> type[BaseException]:
+    """The exception type XLA raises at dispatch/execution time (falls back
+    to RuntimeError on jaxlib layouts that do not export it — the OOM
+    detector keys on the RESOURCE_EXHAUSTED text either way)."""
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+
+        return XlaRuntimeError
+    except ImportError:  # pragma: no cover - jaxlib always has it today
+        return RuntimeError
+
+
+def resource_exhausted_error(nbytes: int = 1 << 33) -> BaseException:
+    """An exception indistinguishable from XLA's device-memory exhaustion:
+    same type, same RESOURCE_EXHAUSTED grammar as a real TPU allocator
+    failure — what ``core.memory.is_oom_error`` must recognize."""
+    return xla_runtime_error_type()(
+        f"RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        f"{nbytes} bytes. (injected fault)"
+    )
+
+
+@contextlib.contextmanager
+def oom_faults(obj, attr: str, failures: int = 1):
+    """Patch ``obj.attr`` to raise RESOURCE_EXHAUSTED for its first
+    ``failures`` calls — e.g. ``oom_faults(block, "_execute_fused_bcd", 1)``
+    makes the next fused BCD dispatch die exactly the way a too-small HBM
+    does, driving the fit ladder's one-tier step-down."""
+    with transient_faults(
+        obj,
+        attr,
+        failures,
+        exc=xla_runtime_error_type(),
+        message="RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "8589934592 bytes. (injected fault)",
+    ) as wrapper:
+        yield wrapper
 
 
 def inject_nan(batch, rng, frac: float = 0.01):
