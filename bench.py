@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
+from keystone_tpu.core.resilience import counters as fault_counters
 from keystone_tpu.ops.fisher import FisherVector
 from keystone_tpu.ops.sift import SIFTExtractor
 from keystone_tpu.solvers.block import BlockLeastSquaresEstimator
@@ -1042,6 +1043,11 @@ def main():
             cifar["solve_examples_per_sec"], 2
         ),
         "solve_device_seconds": round(cifar["solve_device_seconds"], 6),
+        # Degradation ledger for this whole bench process: IO retries,
+        # corrupt-member skips, jitter recoveries, OOM step-downs,
+        # skew-guard fallbacks... — so BENCH_r06+ rows show the faults the
+        # numbers were earned under, not just the perf (empty dict = clean).
+        "faults": fault_counters.counts(),
         "extra_metrics": {
             "imagenet_fv_featurize": (
                 fv
@@ -1098,6 +1104,7 @@ def main():
             f"threaded {jd['threaded_images_per_sec']}/s "
             f"(x{jd['speedup']})"
         )
+    print(f"# faults: {record['faults'] if record['faults'] else 'none'}")
 
 
 if __name__ == "__main__":

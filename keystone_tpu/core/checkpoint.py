@@ -51,6 +51,37 @@ class CheckpointError(RuntimeError):
     """Unserializable node, missing/corrupt artifact, or schema mismatch."""
 
 
+class CheckpointMismatch(CheckpointError):
+    """The checkpoint was written under a DIFFERENT device/mesh topology
+    than the loading process and its arrays were not fully replicated —
+    restoring would silently change placement/sharding of a model that was
+    solved distributed.  Re-load on the recorded topology, or re-fit."""
+
+
+def _current_topology() -> dict:
+    """Device/mesh fingerprint recorded into every manifest: the platform,
+    the visible device count, and the ambient ``use_mesh`` shape (if any)."""
+    from ..parallel.mesh import current_mesh
+
+    devs = jax.devices()
+    mesh = current_mesh()
+    return {
+        "platform": devs[0].platform,
+        "device_count": len(devs),
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+    }
+
+
+def _is_replicated(v) -> bool:
+    """True unless ``v`` is a jax.Array actually sharded over >1 device."""
+    if not isinstance(v, jax.Array):
+        return True
+    try:
+        return len(v.sharding.device_set) <= 1 or v.is_fully_replicated
+    except Exception:  # noqa: BLE001 — unknown sharding: assume sharded
+        return False
+
+
 def checkpoint_paths(path: str) -> tuple[str, str]:
     """``path`` is a stem (``.npz``/``.json`` suffixes are stripped if
     given); returns (npz_path, manifest_path)."""
@@ -99,11 +130,14 @@ class _Encoder:
     def __init__(self):
         self.arrays: dict[str, np.ndarray] = {}
         self.specs: dict[str, dict] = {}
+        self.all_replicated = True
         self._n = 0
 
     def add_array(self, v) -> str:
         key = f"a{self._n}"
         self._n += 1
+        if not _is_replicated(v):
+            self.all_replicated = False
         arr = np.asarray(jax.device_get(v))
         spec = {"dtype": arr.dtype.name, "shape": list(arr.shape)}
         if arr.dtype.kind not in _NATIVE_KINDS:
@@ -293,6 +327,11 @@ def save_pipeline(path: str, pipe) -> str:
         # .npz next to an old .json (or vice versa) — the hash check on
         # load rejects any mixed pair.
         "npz_sha256": hashlib.sha256(npz_bytes).hexdigest(),
+        # Where this checkpoint was solved: the load path refuses to
+        # restore NON-replicated arrays onto a different topology (see
+        # CheckpointMismatch) instead of silently resharding them.
+        "topology": _current_topology(),
+        "all_replicated": enc.all_replicated,
         "root": root,
         "arrays": enc.specs,
     }
@@ -347,6 +386,24 @@ def load_pipeline(path: str):
         raise CheckpointError(
             f"{manifest_path}: format version {manifest.get('version')} "
             f"(this build reads {FORMAT_VERSION})"
+        )
+    recorded = manifest.get("topology")
+    if recorded is not None and not manifest.get("all_replicated", True):
+        # Sharded state is only restorable onto the topology it was
+        # solved on; anything else must fail TYPED, not reshard silently.
+        current = _current_topology()
+        if recorded != current:
+            raise CheckpointMismatch(
+                f"{manifest_path}: checkpoint holds sharded (non-replicated) "
+                f"arrays solved on topology {recorded}, but this process is "
+                f"{current} — refusing to silently reshard; load on the "
+                "recorded topology or re-fit"
+            )
+    elif recorded is None:
+        _logger.warning(
+            "%s: no topology recorded (pre-mesh-guard checkpoint) — "
+            "loading without a placement check",
+            manifest_path,
         )
     import hashlib
     import io

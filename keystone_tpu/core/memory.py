@@ -28,6 +28,14 @@ stack:
 * :class:`FitReport` — the audit trail (per-tier plans, chosen tier,
   denials, OOM retries) estimators expose as ``last_fit_report`` and the
   bench emits verbatim, so the OOM boundary is measured, not guessed.
+* **Mesh mode** — ``plan_program(mesh=...)`` models a GSPMD program
+  per chip: ``NamedSharding``-annotated avals charge their SHARD's bytes
+  (replicated operands charge whole, conservatively), admission runs
+  against the MINIMUM per-chip free HBM across ``mesh.devices``
+  (:func:`min_chip_budget`), and the compiled SPMD module's own per-device
+  ``memory_analysis()`` rides along as ground truth (``plan.reported``).
+  The solvers' mesh ladders use it to step full mesh → reduced-model mesh
+  → the single-device ladder instead of dying on one tight chip.
 
 Temp-size caveat: CPU backends report ``temp_size_in_bytes == 0``, which
 would make a fused program look cheaper than its own stepwise decomposition.
@@ -91,6 +99,61 @@ def budget_is_live() -> bool:
     return not os.environ.get(HBM_BUDGET_ENV, "").strip()
 
 
+def min_chip_budget(mesh) -> tuple[int | None, Any]:
+    """``(budget_bytes, device)``: the SMALLEST per-chip byte budget across
+    ``mesh.devices`` and the chip it came from — what a GSPMD program must
+    be admitted against, because XLA allocates the sharded program on every
+    participating chip and the tightest one is the one that OOMs.
+
+    ``KEYSTONE_HBM_BUDGET`` keeps its override role with PER-CHIP capacity
+    semantics (a mesh of 16 GB chips is ``16G``, not ``256G``).  Without the
+    env, every device's live ``memory_stats()`` free bytes are read; if ANY
+    participating chip cannot report (CPU backends), the answer is
+    ``(None, None)`` — admission is skipped, never guessed from a subset of
+    the mesh."""
+    raw = os.environ.get(HBM_BUDGET_ENV, "").strip()
+    if raw:
+        return parse_bytes(raw), None
+    worst: int | None = None
+    worst_dev = None
+    for dev in mesh.devices.flat:
+        free = hbm_budget(dev)
+        if free is None:
+            return None, None
+        if worst is None or free < worst:
+            worst, worst_dev = free, dev
+    return worst, worst_dev
+
+
+def shard_bytes(aval, mesh=None) -> int:
+    """Per-chip bytes of one array/ShapeDtypeStruct under its sharding.
+
+    A ``NamedSharding``-annotated aval contributes its SHARD's bytes (the
+    sharding's per-device ``shard_shape``); anything un-annotated — or
+    annotated replicated — contributes its full bytes, the conservative
+    fallback (a replicated operand really does occupy full size on every
+    chip).  This is the per-axis division the mesh admission model is built
+    on: a ``(data=4, model=2)``-sharded design matrix charges 1/4 of its
+    global bytes to each chip, its replicated gram factors charge whole."""
+    import numpy as np
+
+    n = 1
+    for dim in aval.shape:
+        n *= int(dim)
+    total = n * np.dtype(aval.dtype).itemsize
+    sharding = getattr(aval, "sharding", None)
+    if sharding is None or not hasattr(sharding, "shard_shape"):
+        return total
+    try:
+        shard = sharding.shard_shape(tuple(aval.shape))
+    except Exception:  # noqa: BLE001 — unshardable spec: charge whole
+        return total
+    m = 1
+    for dim in shard:
+        m *= int(dim)
+    return m * np.dtype(aval.dtype).itemsize
+
+
 def hbm_budget(device=None) -> int | None:
     """Bytes a program may plan against, or ``None`` when unknowable.
 
@@ -134,6 +197,14 @@ class MemoryPlan:
     resident_bytes: int = 0  # of total, already allocated on device
     total_bytes: int = 0
     analyzed: bool = False  # False: no compile happened (no budget known)
+    #: mesh mode: the (data, model) axis sizes the per-chip numbers assume.
+    #: When set, argument/temp/output/total_bytes above are PER-CHIP.
+    mesh_axes: dict | None = None
+    #: mesh mode: the raw ``memory_analysis()`` numbers of the compiled
+    #: SPMD module (XLA's own per-device accounting) kept alongside the
+    #: analytic per-axis division, so the admission model is auditable
+    #: against ground truth in every record.
+    reported: dict | None = None
     error: str | None = None
     compiled: Any = dataclasses.field(default=None, repr=False, compare=False)
 
@@ -153,6 +224,13 @@ class MemoryPlan:
             "budget_gb": gb(self.budget_bytes) if self.budget_bytes else None,
             "reason": self.reason,
         }
+        if self.mesh_axes is not None:
+            out["per_chip"] = True
+            out["mesh"] = dict(self.mesh_axes)
+            if self.reported is not None:
+                out["xla_reported_gb"] = {
+                    k: gb(v) for k, v in self.reported.items()
+                }
         if self.error:
             out["error"] = self.error[:200]
         return out
@@ -168,6 +246,18 @@ _UNSET = object()
 _plan_cache: dict = {}
 
 
+#: label -> number of REAL AOT lower+compiles plan_program performed (cache
+#: misses only).  The AOT-reuse contract — "the per-block program compiles
+#: exactly once: at preflight" — is asserted against this in the tests.
+_compile_counts: dict[str, int] = {}
+
+
+def compile_count(label: str) -> int:
+    """How many times a plan labeled ``label`` actually compiled (plan-cache
+    hits don't count — they reuse the executable)."""
+    return _compile_counts.get(label, 0)
+
+
 def clear_plan_cache() -> None:
     """Drop every cached plan analysis AND its compiled executable.  Loaded
     executables can reserve device program memory; probe-style callers
@@ -180,10 +270,35 @@ def _cache_key(fn, args, kwargs):
     sig = []
     for a in (*args, *sorted(kwargs.items())):
         if hasattr(a, "shape") and hasattr(a, "dtype"):
-            sig.append(("arr", tuple(a.shape), str(a.dtype)))
+            # The sharding is part of the compiled program's identity: the
+            # same shapes planned for a (4, 2) mesh and an (8, 1) mesh are
+            # different SPMD modules with different per-chip footprints.
+            sharding = getattr(a, "sharding", None)
+            sig.append(("arr", tuple(a.shape), str(a.dtype), str(sharding)))
         else:
             sig.append(("static", a))
     return (id(fn), tuple(sig))
+
+
+def _per_chip_output_bytes(fn, args, kwargs, compiled) -> int | None:
+    """Analytic per-chip output bytes of a planned SPMD program: the out
+    avals (``eval_shape`` — abstract, allocates nothing) divided by the
+    compiled executable's actual output shardings.  ``None`` when either
+    side is unavailable (old jaxlib without ``output_shardings``, or a
+    tree-shape mismatch) — the caller falls back to XLA's reported number."""
+    try:
+        out_avals = jax.tree_util.tree_leaves(jax.eval_shape(fn, *args, **kwargs))
+        out_shardings = jax.tree_util.tree_leaves(compiled.output_shardings)
+        if len(out_avals) != len(out_shardings):
+            return None
+        total = 0
+        for aval, sh in zip(out_avals, out_shardings):
+            total += shard_bytes(
+                jax.ShapeDtypeStruct(aval.shape, aval.dtype, sharding=sh)
+            )
+        return total
+    except Exception:  # noqa: BLE001 — advisory refinement only
+        return None
 
 
 def plan_program(
@@ -195,6 +310,7 @@ def plan_program(
     min_temp_bytes: int = 0,
     resident_bytes: int = 0,
     require_analysis: bool = False,
+    mesh=None,
     **kwargs,
 ) -> MemoryPlan:
     """Preflight ``fn`` (a ``jax.jit``-wrapped callable) on ``args``.
@@ -215,7 +331,24 @@ def plan_program(
     With no budget and no ``require_analysis`` the plan is a zero-cost
     pass-through: admitted, unanalyzed, reason recorded.  Denials are
     counted under ``hbm_preflight_denied``.
+
+    **Mesh mode** (``mesh=`` a ``jax.sharding.Mesh``): the program is a
+    GSPMD solve and every byte figure becomes PER-CHIP.  Arguments and
+    outputs are divided by the per-axis sharding of each
+    ``NamedSharding``-annotated aval (:func:`shard_bytes`; replicated or
+    un-annotated operands conservatively charge full size — they really do
+    live whole on every chip), and the default budget is the MINIMUM
+    per-chip free HBM across ``mesh.devices`` (:func:`min_chip_budget`;
+    ``KEYSTONE_HBM_BUDGET`` overrides with per-chip capacity semantics).
+    The compiled SPMD module's own ``memory_analysis()`` — which XLA also
+    reports per device — is kept in ``plan.reported`` as the ground truth
+    the analytic division is audited against; admission charges the LARGER
+    of the two for each category, so a spec the analytic model cannot see
+    through (e.g. a resharded intermediate) still cannot under-admit.
+    ``resident_bytes`` credit is not modeled per chip; mesh callers pass 0.
     """
+    if mesh is not None and budget is _UNSET:
+        budget, _worst = min_chip_budget(mesh)
     if budget is _UNSET:
         budget = hbm_budget()
     if budget is None and not require_analysis:
@@ -226,6 +359,7 @@ def plan_program(
                 "no HBM budget known (no device memory_stats and "
                 f"{HBM_BUDGET_ENV} unset) — admission skipped"
             ),
+            mesh_axes=dict(mesh.shape) if mesh is not None else None,
         )
 
     key = _cache_key(fn, args, kwargs)
@@ -242,10 +376,15 @@ def plan_program(
                 "compiled": compiled,
                 "error": None,
             }
+            if mesh is not None:
+                cached["sharded_out"] = _per_chip_output_bytes(
+                    fn, args, kwargs, compiled
+                )
             # Only SUCCESSFUL analyses are cached: a compile failure can be
             # transient (program-memory pressure from live buffers), and
             # caching it would deny this tier for the rest of the process.
             _plan_cache[key] = cached
+            _compile_counts[label] = _compile_counts.get(label, 0) + 1
         except Exception as e:  # noqa: BLE001 — a compile OOM IS an answer
             cached = {"error": f"{type(e).__name__}: {e}"[:300]}
 
@@ -256,40 +395,70 @@ def plan_program(
             reason=f"lower/compile failed: {cached['error'][:120]}",
             budget_bytes=budget,
             analyzed=False,
+            mesh_axes=dict(mesh.shape) if mesh is not None else None,
             error=cached["error"],
         )
         counters.record("hbm_preflight_denied", f"{label}: {plan.reason}")
         return plan
 
+    reported = None
+    if mesh is None:
+        arg_bytes = cached["argument"]
+        out_bytes = cached["output"]
+    else:
+        reported = {
+            k: cached[k] for k in ("argument", "temp", "output", "alias")
+        }
+        # Analytic per-axis division of the argument avals; XLA's own
+        # per-device module accounting is the floor (max of the two), so a
+        # replicated-in-practice operand the annotations promised sharded
+        # still charges what the compiled module will really hold.
+        analytic_args = sum(
+            shard_bytes(a)
+            for a in (*args, *(v for _, v in sorted(kwargs.items())))
+            if hasattr(a, "shape") and hasattr(a, "dtype")
+        )
+        arg_bytes = max(analytic_args, cached["argument"])
+        sharded_out = cached.get("sharded_out")
+        out_bytes = (
+            max(sharded_out, cached["output"])
+            if sharded_out is not None
+            else cached["output"]
+        )
+
     temp = max(cached["temp"], min_temp_bytes)
-    total = (
-        cached["argument"] + temp + cached["output"] - cached["alias"]
-        + extra_bytes
-    )
+    total = arg_bytes + temp + out_bytes - cached["alias"] + extra_bytes
     credit = resident_bytes if budget_is_live() else 0
     admitted = budget is None or total - credit <= budget
     h = fmt_bytes
     reason = (
-        f"args {h(cached['argument'])} + temp {h(temp)} + "
-        f"out {h(cached['output'])} - alias {h(cached['alias'])} "
+        ("per-chip " if mesh is not None else "")
+        + f"args {h(arg_bytes)} + temp {h(temp)} + "
+        f"out {h(out_bytes)} - alias {h(cached['alias'])} "
         f"+ extra {h(extra_bytes)} = {h(total)}"
         + (f" (- {h(credit)} already resident)" if credit else "")
         + " vs "
-        + (f"budget {h(budget)}" if budget is not None else "no budget")
+        + (
+            f"min-free-chip budget {h(budget)} on mesh {dict(mesh.shape)}"
+            if mesh is not None and budget is not None
+            else f"budget {h(budget)}" if budget is not None else "no budget"
+        )
     )
     plan = MemoryPlan(
         label=label,
         admitted=admitted,
         reason=("fits: " if admitted else "DENIED: ") + reason,
         budget_bytes=budget,
-        argument_bytes=cached["argument"],
+        argument_bytes=arg_bytes,
         temp_bytes=temp,
-        output_bytes=cached["output"],
+        output_bytes=out_bytes,
         alias_bytes=cached["alias"],
         extra_bytes=extra_bytes,
         resident_bytes=resident_bytes,
         total_bytes=total,
         analyzed=True,
+        mesh_axes=dict(mesh.shape) if mesh is not None else None,
+        reported=reported,
         compiled=cached["compiled"],
     )
     if not admitted:
@@ -374,11 +543,16 @@ class FitReport:
     chosen: str | None = None
     denials: list = dataclasses.field(default_factory=list)
     oom_retries: list = dataclasses.field(default_factory=list)
+    #: mesh ladders: the (data, model) axis sizes of the mesh that actually
+    #: RAN the solve; ``None`` after a step-down to the single-device floor
+    #: (and for plain single-device fits).
+    mesh_shape: dict | None = None
 
     def record(self) -> dict:
         """JSON-able form for bench artifacts."""
         return {
             "chosen_tier": self.chosen,
+            "mesh_shape": dict(self.mesh_shape) if self.mesh_shape else None,
             "budget_gb": (
                 round(self.budget_bytes / 2**30, 3) if self.budget_bytes else None
             ),
@@ -389,6 +563,8 @@ class FitReport:
 
     def summary(self) -> str:
         s = f"{self.label}: tier={self.chosen}"
+        if self.mesh_shape:
+            s += f", mesh={self.mesh_shape}"
         if self.denials:
             s += f", denied={self.denials}"
         if self.oom_retries:

@@ -15,13 +15,21 @@ pipeline has no substrate doing that, so the primitives live here:
 * :func:`assert_all_finite` — the fit-path guard: every float leaf of a
   fitted model pytree must be finite, else the fit fails loudly instead of
   serving NaN predictions.
+* :func:`deadline` / :class:`DeadlineExceeded` — the wall-clock watchdog:
+  a phase that hangs (dead interconnect, a collective waiting on a
+  preempted peer, an IO mount that went away) is converted into a typed,
+  counted error naming the phase, instead of stalling the whole pipeline
+  forever.  Spark got this from task speculation + executor heartbeats;
+  a single-controller process has to arm its own timer.
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import logging
 import os
+import signal
 import threading
 import time
 from typing import Callable
@@ -209,9 +217,96 @@ def assert_all_finite(tree, name: str = "fitted model"):
         if not finite:
             bad.append(i)
     if bad:
+        counters.record(
+            "nonfinite_model", f"{name}: {len(bad)} non-finite leaf/leaves"
+        )
         raise FloatingPointError(
             f"{name} contains non-finite values in {len(bad)} leaf/leaves "
             f"(indices {bad}) — refusing to ship a silently-broken model "
             "(ill-conditioned solve, NaN input batch, or overflow upstream)"
         )
     return tree
+
+
+# -- wall-clock watchdog ------------------------------------------------------
+
+
+class DeadlineExceeded(RuntimeError):
+    """A pipeline phase blew its wall-clock budget.  Typed (never a bare
+    traceback), carries the ``phase`` name and the budget so operators and
+    the chaos harness can assert WHICH stage hung."""
+
+    def __init__(self, phase: str, seconds: float):
+        super().__init__(
+            f"phase {phase!r} exceeded its {seconds:g}s deadline — "
+            "converting the hang into a typed failure"
+        )
+        self.phase = phase
+        self.seconds = seconds
+
+
+@contextlib.contextmanager
+def deadline(seconds: float, phase: str = "work"):
+    """Bound a pipeline phase by wall clock: the block either finishes
+    within ``seconds`` or dies with :class:`DeadlineExceeded` (counted
+    under ``deadline_exceeded``), never hangs silently.
+
+    On the main thread of a POSIX process the watchdog is a real
+    ``SIGALRM`` interval timer, so a genuine hang (a sleep, a stuck read,
+    a collective waiting on a dead peer — anything that re-enters the
+    Python interpreter) is interrupted mid-flight.  Off the main thread
+    (or on platforms without ``setitimer``) signals cannot be armed; the
+    fallback checks elapsed time on exit, converting an overrun — though
+    not a true never-returns hang — into the same typed error.  Deadlines
+    nest: the TIGHTER of the inner budget and the enclosing deadline's
+    remaining time is armed (so an outer bound is never suspended by a
+    looser inner block), and on inner exit the outer timer is re-armed
+    with whatever it has left.
+    """
+    if seconds <= 0:
+        raise ValueError(f"deadline seconds must be positive, got {seconds}")
+
+    armed = False
+    old_handler = None
+    old_delay = 0.0
+    budget = seconds
+    t0 = time.monotonic()
+
+    def _trip(signum, frame):
+        counters.record(
+            "deadline_exceeded", f"{phase}: wall clock exceeded {budget:g}s"
+        )
+        raise DeadlineExceeded(phase, budget)
+
+    try:
+        old_handler = signal.signal(signal.SIGALRM, _trip)
+        old_delay = signal.setitimer(signal.ITIMER_REAL, seconds)[0]
+        if 0.0 < old_delay < seconds:
+            # An ENCLOSING deadline had less time left than this block asks
+            # for: arming the full inner budget would suspend the outer
+            # bound for the inner block's whole duration.  The tighter
+            # remaining budget wins (the trip is attributed to the phase
+            # that was executing — this one).
+            budget = old_delay
+            signal.setitimer(signal.ITIMER_REAL, old_delay)
+        armed = True
+    except (ValueError, AttributeError, OSError):
+        # Not the main thread / no setitimer: post-hoc fallback below.
+        pass
+    try:
+        yield
+        if not armed and time.monotonic() - t0 > seconds:
+            counters.record(
+                "deadline_exceeded",
+                f"{phase}: wall clock exceeded {seconds:g}s (post-hoc)",
+            )
+            raise DeadlineExceeded(phase, seconds)
+    finally:
+        if armed:
+            signal.setitimer(signal.ITIMER_REAL, 0.0)
+            signal.signal(signal.SIGALRM, old_handler)
+            if old_delay > 0.0:
+                # Re-arm the enclosing deadline with whatever it has left
+                # (floor at a tick so it still fires if already overdue).
+                remaining = max(old_delay - (time.monotonic() - t0), 1e-3)
+                signal.setitimer(signal.ITIMER_REAL, remaining)

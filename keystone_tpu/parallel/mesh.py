@@ -35,6 +35,26 @@ def make_mesh(data: int | None = None, model: int = 1, devices=None) -> Mesh:
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 
+def reduced_mesh(mesh: Mesh) -> Mesh | None:
+    """The next rung of the mesh degradation ladder: the SAME devices with
+    the ``model`` axis collapsed into ``data`` — ``(data=4, model=2)`` →
+    ``(data=8, model=1)``.  Model blocks replicate instead of sharding, and
+    in exchange every row-sharded operand (the design matrix, labels, the
+    residual — the terms that dominate a solve's per-chip footprint) holds
+    half as many rows per chip.  ``None`` when the mesh is already pure
+    data-parallel (nothing left to collapse; the ladder's next rung is the
+    single-device floor)."""
+    if mesh.shape[MODEL_AXIS] <= 1:
+        return None
+    devices = list(mesh.devices.flat)
+    return make_mesh(data=len(devices), model=1, devices=devices)
+
+
+def mesh_desc(mesh: Mesh) -> str:
+    """``'4x2'`` — the (data, model) shape tag used in tier names."""
+    return f"{mesh.shape[DATA_AXIS]}x{mesh.shape[MODEL_AXIS]}"
+
+
 _current_mesh: list[Mesh] = []
 
 

@@ -26,7 +26,15 @@ from ..core.checkpoint import CheckpointError, _atomic_write_bytes
 from ..core.pipeline import Identity, LabelEstimator, Transformer
 from ..ops.stats import StandardScalerModel
 from ..ops.util import VectorSplitter
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, current_mesh, pad_shard_inputs
+from ..parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    current_mesh,
+    mesh_desc,
+    pad_shard_inputs,
+    reduced_mesh,
+    row_sharding,
+)
 
 _logger = logging.getLogger("keystone_tpu.solvers.block")
 
@@ -230,18 +238,51 @@ def _fused_bcd_fit_variant(donate_argnums: tuple = ()):
 _fused_bcd_fit = _fused_bcd_fit_variant(())
 
 
+def _single_device_arrays(*arrays) -> bool:
+    """True when no argument is a multi-device (sharded) jax.Array — the
+    precondition for executing an AOT program planned on unsharded avals
+    (its baked SingleDeviceSharding would reject sharded inputs)."""
+    for a in arrays:
+        if isinstance(a, jax.Array):
+            try:
+                if len(a.sharding.device_set) > 1:
+                    return False
+            except Exception:  # noqa: BLE001 — unknown sharding: be safe
+                return False
+    return True
+
+
 def _execute_fused_bcd(plan, donate_argnums, x, labels, lam, nvalid,
                        num_iter: int, widths):
     """Dispatch the fused program: the planned AOT executable when admission
     ran (so the very program that was planned is the one executed), else the
-    jitted variant (jit-cache-friendly when no budget is known).  Module
+    jitted variant (jit-cache-friendly when no budget is known, and the
+    resilient fallback when a caller hands SHARDED arrays to a mesh-less
+    fit — the planned executable baked single-device placements).  Module
     level so the fault harness can intercept it (tests inject
     RESOURCE_EXHAUSTED here to exercise the ladder's step-down)."""
-    if plan is not None and plan.compiled is not None:
+    if (
+        plan is not None
+        and plan.compiled is not None
+        and _single_device_arrays(x, labels)
+    ):
         return plan.compiled(x, labels, lam, nvalid)
     return _fused_bcd_fit_variant(donate_argnums)(
         x, labels, lam, nvalid, num_iter, widths, None
     )
+
+
+def _execute_fused_bcd_mesh(plan, x, labels, lam, nvalid, num_iter: int,
+                            widths, mesh):
+    """Dispatch the GSPMD fused program for one mesh-ladder tier.  The
+    jitted entry — not ``plan.compiled`` — is used deliberately: an AOT
+    executable bakes committed input shardings and scalar placements that a
+    later call's padded inputs need not match exactly, while the jit cache
+    keys on the same (aval, sharding) signature and reuses its own
+    compilation.  Module level so the chaos harness can inject
+    RESOURCE_EXHAUSTED here to drive the mesh ladder's step-down."""
+    del plan
+    return _fused_bcd_fit(x, labels, lam, nvalid, num_iter, widths, mesh)
 
 
 def _blocked_design_matrix(features, block_size: int, num_features=None):
@@ -485,6 +526,7 @@ def _stepwise_bcd_fit(
     widths,
     checkpoint_cb: Callable[[dict], None] | None = None,
     resume_state: dict | None = None,
+    block_solve=None,
 ):
     """The resumable form of ``_fused_bcd_fit``: same centering, masking,
     pad-column shift, and per-block update, but driven from the host one
@@ -496,6 +538,12 @@ def _stepwise_bcd_fit(
     the per-block program is still one compiled step (``_bcd_block_step``),
     so the extra cost is one dispatch round-trip per block plus whatever
     the callback spends persisting state.
+
+    ``block_solve``: the preflight's AOT-compiled per-block solve executable
+    (``plan.compiled`` from the stepwise tier's admission plan — statics
+    baked, same avals).  When given, the degraded path executes the very
+    program that was planned instead of re-compiling ``_bcd_block_solve``
+    at first jit dispatch; ``None`` falls back to the jitted entry.
     """
     bs = max(widths)
     nb = len(widths)
@@ -564,6 +612,11 @@ def _stepwise_bcd_fit(
         e0, b0 = 0, 0
 
     lam_arr = jnp.asarray(lam, dtype)
+
+    def jit_block_solve(*a):
+        return _bcd_block_solve(*a, bs)
+
+    solve = block_solve if block_solve is not None else jit_block_solve
     chol_cache: dict[int, jax.Array] = {}  # factors are constant across epochs
     for e in range(e0, num_iter):
         for i in range(b0 if e == e0 else 0, nb):
@@ -578,7 +631,7 @@ def _stepwise_bcd_fit(
                     jnp.asarray(i, jnp.int32),
                     bs,
                 )
-            m_new, residual = _bcd_block_solve(
+            m_new, residual = solve(
                 x,
                 mu,
                 mask,
@@ -586,7 +639,6 @@ def _stepwise_bcd_fit(
                 models[i],
                 c_i,
                 jnp.asarray(i, jnp.int32),
-                bs,
             )
             models = models.at[i].set(m_new)
             if checkpoint_cb is not None:
@@ -661,12 +713,17 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         one dispatch per block); both are single-host (mesh unsupported —
         preempted multi-chip fits restart whole).
 
-        Memory resilience (single-device fits): the solve runs a degradation
-        ladder — fused one-program → stepwise per-block → host-staged block
-        streaming — each tier preflighted against the HBM budget
+        Memory resilience: the solve runs a degradation ladder.  Without a
+        mesh: fused one-program → stepwise per-block → host-staged block
+        streaming, each tier preflighted against the HBM budget
         (core.memory.plan_program; ``KEYSTONE_HBM_BUDGET`` overrides for
         testing) and a runtime ``RESOURCE_EXHAUSTED`` steps down one tier
-        instead of killing the fit.  ``donate``: tri-state — ``None``
+        instead of killing the fit.  With a mesh the ladder grows mesh
+        tiers above those: full ``(data, model)`` mesh → model-axis-
+        collapsed mesh → the single-device ladder, with each mesh tier
+        admitted PER CHIP against the minimum free HBM across the mesh's
+        devices and ``last_fit_report.mesh_shape`` recording which mesh
+        actually ran.  ``donate``: tri-state — ``None``
         (default) donates the design matrix/labels into the fused program
         only when they are buffers this fit created (host uploads, padded
         copies), ``True`` forces donation of caller-owned device arrays
@@ -686,19 +743,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             features, self.block_size, num_features
         )
 
-        col_pad = 0
-        if mesh is not None:
-            (x, labels), nvalid = pad_shard_inputs(mesh, nvalid, x, labels)
-            # Class columns shard over the model axis; zero label columns
-            # stay zero through every BCD update, so the pad is exact.
-            m_size = mesh.shape[MODEL_AXIS]
-            col_pad = (-labels.shape[1]) % m_size
-            if col_pad:
-                labels = jnp.pad(labels, ((0, 0), (0, col_pad)))
-
-        if nvalid is None:
-            nvalid = int(jnp.shape(labels)[0])
         if resumable:
+            if nvalid is None:
+                nvalid = int(jnp.shape(labels)[0])
             self.last_fit_report = kmem.FitReport(
                 label="bcd_fit", chosen="stepwise[checkpoint]"
             )
@@ -721,28 +768,18 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 resume_state=state,
             )
         elif mesh is not None:
-            # Multi-chip path: per-chip admission of a GSPMD program is not
-            # modeled (memory_analysis reports whole-program bytes); the
-            # sharded fused program runs directly, as before.
-            self.last_fit_report = kmem.FitReport(
-                label="bcd_fit", chosen="fused[mesh]"
-            )
-            models, label_mean, means = _fused_bcd_fit(
-                jnp.asarray(x),
-                jnp.asarray(labels),
-                jnp.asarray(self.lam, jnp.asarray(labels).dtype),
-                nvalid,
-                self.num_iter,
-                widths,
-                mesh,
+            # Multi-chip path: the MESH degradation ladder — full
+            # (data, model) mesh with per-chip admission, then the
+            # model-axis-collapsed mesh, then the single-device ladder.
+            models, label_mean, means = self._fit_mesh_ladder(
+                features, x, labels, num_features, nvalid, widths, mesh
             )
         else:
+            if nvalid is None:
+                nvalid = int(jnp.shape(labels)[0])
             models, label_mean, means = self._fit_ladder(
                 features, x, labels, num_features, nvalid, widths, donate
             )
-        if col_pad:
-            models = models[:, :, : models.shape[2] - col_pad]
-            label_mean = label_mean[: label_mean.shape[0] - col_pad]
         model_list = [models[i, :w] for i, w in enumerate(widths)]
         feature_scalers = [
             StandardScalerModel(means[i, :w]) for i, w in enumerate(widths)
@@ -751,8 +788,128 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             model_list, self.block_size, label_mean, feature_scalers
         )
 
+    def _fit_mesh_ladder(
+        self, features, x, labels, num_features, nvalid, widths, mesh
+    ):
+        """Distributed solve through the MESH degradation ladder.
+
+        Tiers: the full ``(data, model)`` mesh → the model-axis-collapsed
+        mesh (same chips, pure data-parallel: row-sharded operands halve
+        per chip while model blocks replicate) → the single-device ladder
+        (fused → stepwise → host-staged) on host-pulled inputs.  Each mesh
+        tier is preflighted PER CHIP (``plan_program(mesh=...)`` against
+        the minimum free HBM across participating chips) and a runtime
+        ``RESOURCE_EXHAUSTED`` from any chip steps down exactly one tier —
+        the Spark-executor admission/retry discipline, rebuilt for GSPMD.
+        ``report.mesh_shape`` records which mesh actually ran the solve.
+        """
+        bs, nb = max(widths), len(widths)
+        n0 = int(np.shape(labels)[0])
+        k = int(np.shape(labels)[1])
+        nvalid0 = nvalid if nvalid is not None else n0
+        dtype = jax.dtypes.canonicalize_dtype(
+            getattr(labels, "dtype", np.float32)
+        )
+        xdt = jax.dtypes.canonicalize_dtype(x.dtype)
+        it = np.dtype(dtype).itemsize
+        lam_arr = jnp.asarray(self.lam, dtype)
+
+        report = kmem.FitReport(label="bcd_fit")
+        self.last_fit_report = report
+
+        def mesh_tier(m):
+            name = f"fused[mesh {mesh_desc(m)}]"
+            d_sz, m_sz = m.shape[DATA_AXIS], m.shape[MODEL_AXIS]
+            n_pad = n0 + (-n0) % d_sz
+            k_pad = k + (-k) % m_sz
+
+            def plan():
+                budget, _worst = kmem.min_chip_budget(m)
+                sds = jax.ShapeDtypeStruct
+                row = row_sharding(m)
+                x_s = sds((n_pad, nb * bs), xdt, sharding=row)
+                y_s = sds((n_pad, k_pad), dtype, sharding=row)
+                lam_s, i32_s = sds((), dtype), sds((), jnp.int32)
+                # Analytic per-chip transient floor (CPU backends report
+                # temp 0): one centered row-sharded block, the replicated
+                # Cholesky stack, two residual carries, the model-axis-
+                # sharded models carry.
+                floor = it * (
+                    n_pad * bs // d_sz
+                    + nb * bs * bs
+                    + 2 * n_pad * k_pad // d_sz
+                    + nb * bs * k_pad // m_sz
+                )
+                return kmem.plan_program(
+                    _fused_bcd_fit, x_s, y_s, lam_s, i32_s,
+                    self.num_iter, widths, m,
+                    label=f"bcd_{name}", budget=budget,
+                    min_temp_bytes=floor, mesh=m,
+                )
+
+            def run(plan):
+                report.mesh_shape = dict(m.shape)
+                (x_p, y_p), nv = pad_shard_inputs(m, nvalid0, x, labels)
+                # Class columns shard over the model axis; zero label
+                # columns stay zero through every BCD update — exact pad.
+                col_pad = (-int(jnp.shape(y_p)[1])) % m_sz
+                if col_pad:
+                    y_p = jnp.pad(y_p, ((0, 0), (0, col_pad)))
+                nv = nv if nv is not None else int(jnp.shape(y_p)[0])
+                models, label_mean, means = _execute_fused_bcd_mesh(
+                    plan, jnp.asarray(x_p), jnp.asarray(y_p), lam_arr,
+                    nv, self.num_iter, widths, m,
+                )
+                if col_pad:
+                    models = models[:, :, :k]
+                    label_mean = label_mean[:k]
+                return models, label_mean, means
+
+            return kmem.Tier(name, plan, run)
+
+        def plan_single():
+            return kmem.MemoryPlan(
+                label="single_device",
+                admitted=True,
+                reason=(
+                    "mesh ladder floor: single-device degradation ladder "
+                    "(its own per-tier admission runs inside)"
+                ),
+            )
+
+        inner_chosen = []
+
+        def run_single(_plan):
+            report.mesh_shape = None
+            x_h = (
+                np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x
+            )
+            y_h = (
+                np.asarray(jax.device_get(labels))
+                if isinstance(labels, jax.Array)
+                else labels
+            )
+            out = self._fit_ladder(
+                x_h, x_h, y_h, num_features, nvalid0, widths, None,
+                report=report,
+            )
+            inner_chosen.append(report.chosen)
+            return out
+
+        tiers = [mesh_tier(mesh)]
+        rm = reduced_mesh(mesh)
+        if rm is not None:
+            tiers.append(mesh_tier(rm))
+        tiers.append(kmem.Tier("single_device", plan_single, run_single))
+        out = kmem.run_ladder("bcd_fit", tiers, report)
+        if inner_chosen and report.chosen == "single_device":
+            # Keep the inner rung visible: "single_device/host_staged".
+            report.chosen = f"single_device/{inner_chosen[0]}"
+        return out
+
     def _fit_ladder(
-        self, features, x, labels, num_features, nvalid, widths, donate
+        self, features, x, labels, num_features, nvalid, widths, donate,
+        report=None,
     ):
         """Single-device solve through the degradation ladder.
 
@@ -850,9 +1007,18 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             )
 
         def run_stepwise(plan):
+            x_dev, y_dev = jnp.asarray(get_x()), get_y_dev()
+            reusable = (
+                plan is not None and _single_device_arrays(x_dev, y_dev)
+            )
             return _stepwise_bcd_fit(
-                jnp.asarray(get_x()), get_y_dev(), self.lam, nvalid,
-                self.num_iter, widths,
+                x_dev, y_dev, self.lam, nvalid, self.num_iter, widths,
+                # The preflight already compiled the per-block solve on
+                # these very avals — execute that executable instead of
+                # paying a second compile at first jit dispatch.  (Sharded
+                # caller inputs fall back to the jitted entry: the planned
+                # program baked single-device placements.)
+                block_solve=plan.compiled if reusable else None,
             )
 
         def run_host(plan):
@@ -871,8 +1037,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 x_h, get_y_dev(), self.lam, nvalid, self.num_iter, widths
             )
 
-        report = kmem.FitReport(label="bcd_fit", budget_bytes=budget)
-        self.last_fit_report = report
+        if report is None:
+            report = kmem.FitReport(label="bcd_fit", budget_bytes=budget)
+            self.last_fit_report = report
         return kmem.run_ladder(
             "bcd_fit",
             [

@@ -43,11 +43,39 @@ except ImportError:  # pre-0.5 jax exports it under experimental only
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+import dataclasses
+
 from ..core import memory as kmem
 from ..core.pipeline import LabelEstimator
 from ..core.resilience import counters
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, current_mesh
+from ..parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    current_mesh,
+    mesh_desc,
+    reduced_mesh,
+)
 from .block import BlockLinearMapper, _blocked_design_matrix, _design_matrix_owned
+
+
+@dataclasses.dataclass
+class _SolveCtx:
+    """Mesh-dependent BWLS solve layout for ONE ladder tier: the padded
+    row count, the class-chunk rounding, and the sort/pad/shard closures
+    all follow the tier's mesh axis sizes, so each rung of the mesh
+    degradation ladder builds its own (see ``fit``'s ``prep``)."""
+
+    mesh: object
+    p_tot: int
+    chunk: int
+    sort_pad: object
+    sort_labels: object
+    valid_d: object
+    seg_ids: object
+    starts: object
+    counts: object
+    counts_f: object
+    joint_label_mean: object
 
 # Per-row byte budget for the column-chunked device gather in the class
 # shuffle: each chunk transiently materializes [p_tot, chunk_bytes] un-sharded
@@ -354,10 +382,18 @@ _fused_bwls_fit = _fused_bwls_fit_variant(())
 
 def _execute_fused_bwls(plan, args, statics):
     """Dispatch the fused BWLS program: the planned AOT executable when
-    admission ran, else the donating jitted variant.  Module level so
-    benches capture the exact solve arguments here and the fault harness
-    injects RESOURCE_EXHAUSTED to exercise the ladder step-down."""
-    if plan is not None and plan.compiled is not None:
+    admission ran, else the donating jitted variant (also the resilient
+    fallback when the sorted inputs are sharded — a single-device plan
+    baked single-device placements).  Module level so benches capture the
+    exact solve arguments here and the fault harness injects
+    RESOURCE_EXHAUSTED to exercise the ladder step-down."""
+    from .block import _single_device_arrays
+
+    if (
+        plan is not None
+        and plan.compiled is not None
+        and _single_device_arrays(*args)
+    ):
         return plan.compiled(*args)
     return _fused_bwls_fit_variant((0, 1))(*args, *statics)
 
@@ -398,6 +434,7 @@ def _stepwise_bwls_fit(
     get_block, labels_sorted, valid, seg_ids, starts, counts, counts_f,
     joint_label_mean, nvalid, lam, w,
     num_iter: int, n_max: int, chunk: int, num_classes: int, widths,
+    class_solves=None,
 ):
     """The BWLS solve driven from the host one block at a time — the
     stepwise/host-staged rungs of the degradation ladder.  ``get_block(i)``
@@ -408,6 +445,11 @@ def _stepwise_bwls_fit(
     the residual + the per-block statistics caches).  Statistics are
     computed once and cached across passes, and the update order matches
     ``_fused_bwls_fit`` exactly, so results are numerically identical.
+
+    ``class_solves``: the preflight's AOT-compiled class-solve executable
+    (``plan.compiled`` — statics baked, same avals), so the degraded tier
+    executes the very program admission planned instead of recompiling
+    ``_class_solves`` at first jit dispatch; ``None`` → the jitted entry.
     """
     bs = max(widths)
     nb = len(widths)
@@ -415,6 +457,11 @@ def _stepwise_bwls_fit(
     n = jnp.asarray(nvalid, dtype)
     w_arr = jnp.asarray(w, dtype)
     lam_arr = jnp.asarray(lam, dtype)
+
+    def jit_class_solves(*a):
+        return _class_solves(*a, n_max, chunk, None)
+
+    solves = class_solves if class_solves is not None else jit_class_solves
 
     res = (labels_sorted - joint_label_mean) * valid
     rmean = _residual_class_means(res, seg_ids, counts_f, num_classes)
@@ -439,9 +486,9 @@ def _stepwise_bwls_fit(
             xb = get_block(i)
             pop_cov, pop_mean, jm = stats[i]
             pop_xtr = _bwls_block_xtr(xb, res, n)
-            dw = _class_solves(
+            dw = solves(
                 xb, res, starts, counts, pop_cov, pop_mean, pop_xtr,
-                jm, rmean, models[i], lam_arr, w_arr, n_max, chunk, None,
+                jm, rmean, models[i], lam_arr, w_arr,
             )
             models[i], res = _bwls_block_apply(xb, res, models[i], dw)
             rmean = _residual_class_means(res, seg_ids, counts_f, num_classes)
@@ -517,11 +564,15 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         inputs carry zero pad rows from ``padded_shard_rows``; pad rows are
         excluded from the class grouping.
 
-        Memory resilience (single-device fits): the solve runs the
-        degradation ladder fused one-program → stepwise per-block →
-        host-staged block streaming, each tier preflighted against the HBM
-        budget (core.memory; ``KEYSTONE_HBM_BUDGET`` overrides) and a
-        runtime RESOURCE_EXHAUSTED steps down one tier.  The fused program
+        Memory resilience: the solve runs a degradation ladder.  Without a
+        mesh: fused one-program → stepwise per-block → host-staged block
+        streaming, each tier preflighted against the HBM budget
+        (core.memory; ``KEYSTONE_HBM_BUDGET`` overrides) and a runtime
+        RESOURCE_EXHAUSTED steps down one tier.  With a mesh, mesh tiers
+        sit above those — full ``(data, model)`` mesh → model-axis-
+        collapsed mesh → the single-device ladder — each admitted PER CHIP
+        against the minimum free HBM across the mesh's devices, with
+        ``last_fit_report.mesh_shape`` recording which mesh actually ran.  The fused program
         always donates the SORTED design-matrix/label copies (they are
         fit-private).  ``donate=True`` additionally frees the CALLER's
         device-resident inputs as soon as their sorted copies exist —
@@ -557,152 +608,303 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         dtype = jnp.asarray(x[:1, :1]).dtype
         w = self.mixture_weight
 
-        # Padded row layout: sorted valid rows, then a zero tail of >= n_max
-        # rows (so every dynamic_slice in the class sweep stays in bounds).
-        # The zero tail contributes nothing to gemms/sums, so population
-        # statistics use xb_pad directly with the true count n.  With a mesh
-        # the tail additionally rounds the row count up to a data-axis
-        # multiple and the padded blocks are row-sharded: population
-        # gram/XᵀR gemms lower to local gram + ICI all-reduce.
-        pad_total = n_max
-        row_shard = None
-        if mesh is not None:
-            d_size = mesh.shape[DATA_AXIS]
-            pad_total += (-(n + n_max)) % d_size
-            row_shard = NamedSharding(mesh, P(DATA_AXIS, None))
-        p_tot = n + pad_total
+        def prep(m, labels_src):
+            """Mesh-dependent solve context for one ladder tier.
 
-        # gather index: order for valid rows, then an out-of-range index so
-        # ``mode="fill"`` writes exact zero rows for the tail — the sort and
-        # the padding are a single device gather, no host round-trip.
-        gather_np = np.concatenate(
-            [order, np.full(pad_total, n, dtype=order.dtype)]
-        )
-        gather_idx = jnp.asarray(gather_np)
-        valid = jnp.asarray((gather_np < n).astype(np.float32))[:, None]
-
-        regroup_plans: dict[int, _RegroupPlan] = {}
-
-        def sort_pad(x):
-            """Sorted, zero-tail-padded, (re-)sharded copy of ``x``.
-
-            Host arrays are permuted host-side (no device gather at all).
-            Device-resident arrays under a mesh regroup via the
-            traffic-optimal all_to_all plan (each row crosses the ICI once
-            — see _RegroupPlan for the D-times-less-traffic model).  The
-            fallback for shapes the plan cannot take (row count not a
-            data-axis multiple) is a feature-column-chunked gather: a
-            replicated-index gather over a row-sharded operand makes GSPMD
-            all-gather the operand, so chunking bounds the transient
-            unsharded slab to [p_tot, chunk].  The tail is exact zero in
-            every path (``mode="fill"`` covers sources with exactly n rows;
-            sources carrying their own pad rows at >= n need the mask).
+            Padded row layout: sorted valid rows, then a zero tail of >=
+            n_max rows (so every dynamic_slice in the class sweep stays in
+            bounds).  The zero tail contributes nothing to gemms/sums, so
+            population statistics use xb_pad directly with the true count
+            n.  With a mesh the tail additionally rounds the row count up
+            to a data-axis multiple and the padded blocks are row-sharded:
+            population gram/XᵀR gemms lower to local gram + ICI
+            all-reduce.  Every quantity that depends on the mesh's axis
+            sizes (p_tot, the gather index, seg ids, the class chunk, the
+            sort/regroup closures) lives in the returned context, so each
+            rung of the mesh degradation ladder rebuilds its own layout.
             """
-            if not isinstance(x, jax.Array):
-                xh = np.asarray(x)
-                out_h = np.zeros((p_tot,) + xh.shape[1:], xh.dtype)
-                out_h[:n] = xh[order]
-                out = jnp.asarray(out_h)
+            pad_total = n_max
+            row_shard = None
+            if m is not None:
+                d_size = m.shape[DATA_AXIS]
+                pad_total += (-(n + n_max)) % d_size
+                row_shard = NamedSharding(m, P(DATA_AXIS, None))
+            p_tot = n + pad_total
+
+            # gather index: order for valid rows, then an out-of-range
+            # index so ``mode="fill"`` writes exact zero rows for the tail
+            # — the sort and the padding are a single device gather, no
+            # host round-trip.
+            gather_np = np.concatenate(
+                [order, np.full(pad_total, n, dtype=order.dtype)]
+            )
+            gather_idx = jnp.asarray(gather_np)
+            valid = jnp.asarray((gather_np < n).astype(np.float32))[:, None]
+
+            regroup_plans: dict[int, _RegroupPlan] = {}
+
+            def sort_pad(x):
+                """Sorted, zero-tail-padded, (re-)sharded copy of ``x``.
+
+                Host arrays are permuted host-side (no device gather at
+                all).  Device-resident arrays under a mesh regroup via the
+                traffic-optimal all_to_all plan (each row crosses the ICI
+                once — see _RegroupPlan for the D-times-less-traffic
+                model).  The fallback for shapes the plan cannot take (row
+                count not a data-axis multiple) is a feature-column-chunked
+                gather: a replicated-index gather over a row-sharded
+                operand makes GSPMD all-gather the operand, so chunking
+                bounds the transient unsharded slab to [p_tot, chunk].  The
+                tail is exact zero in every path (``mode="fill"`` covers
+                sources with exactly n rows; sources carrying their own pad
+                rows at >= n need the mask).
+                """
+                if not isinstance(x, jax.Array):
+                    xh = np.asarray(x)
+                    out_h = np.zeros((p_tot,) + xh.shape[1:], xh.dtype)
+                    out_h[:n] = xh[order]
+                    out = jnp.asarray(out_h)
+                    if row_shard is not None:
+                        out = jax.device_put(out, row_shard)
+                    return out
+
+                if m is not None and x.shape[0] % m.shape[DATA_AXIS] == 0:
+                    n_src = x.shape[0]
+                    if n_src not in regroup_plans:
+                        regroup_plans[n_src] = _RegroupPlan(
+                            order, n_src, p_tot, m.shape[DATA_AXIS]
+                        )
+                    plan = regroup_plans[n_src]
+                    if plan.usable:  # else: skew guard — fallback below
+                        return plan.apply(m, jax.device_put(x, row_shard))
+                    # A survivable degradation, counted so operators (and
+                    # the multichip dryrun) can see which regroup path ran.
+                    counters.record(
+                        "bwls_regroup_skew_fallback",
+                        f"d*m_pad {plan.d * plan.m_pad} > 2*rows_out "
+                        f"{2 * plan.rows_out}: bucket padding beyond 2x "
+                        "optimal — taking the chunked-gather fallback",
+                    )
+
+                chunk_cols = max(1, _GATHER_COL_CHUNK // max(1, x.itemsize))
+                if x.shape[1] <= chunk_cols:
+                    g = jnp.take(
+                        x, gather_idx, axis=0, mode="fill", fill_value=0
+                    )
+                    g = g * valid.astype(x.dtype)
+                    return (
+                        g if row_shard is None else jax.device_put(g, row_shard)
+                    )
+                # Chunks land in a PREALLOCATED output via a donating
+                # dynamic-update-slice, so peak HBM is source + output +
+                # one chunk (~2x the design matrix).  The round-5 form
+                # accumulated all chunks in a list and concatenated —
+                # source + chunks + concat output, ~3x transient (ADVICE
+                # r5 medium).
+                out = jnp.zeros((p_tot, x.shape[1]), x.dtype)
                 if row_shard is not None:
                     out = jax.device_put(out, row_shard)
+                for c0 in range(0, x.shape[1], chunk_cols):
+                    sl = jax.lax.slice_in_dim(
+                        x, c0, min(c0 + chunk_cols, x.shape[1]), axis=1
+                    )
+                    g = jnp.take(
+                        sl, gather_idx, axis=0, mode="fill", fill_value=0
+                    )
+                    g = g * valid.astype(x.dtype)
+                    if row_shard is not None:
+                        # Reshard each slab as it lands so at most one
+                        # unsharded chunk is transient at a time.
+                        g = jax.device_put(g, row_shard)
+                    out = _scatter_cols(out, g, jnp.int32(c0))
                 return out
 
-            if mesh is not None and x.shape[0] % mesh.shape[DATA_AXIS] == 0:
-                n_src = x.shape[0]
-                if n_src not in regroup_plans:
-                    regroup_plans[n_src] = _RegroupPlan(
-                        order, n_src, p_tot, mesh.shape[DATA_AXIS]
-                    )
-                plan = regroup_plans[n_src]
-                if plan.usable:  # else: skew guard — chunked fallback below
-                    return plan.apply(mesh, jax.device_put(x, row_shard))
-                # A survivable degradation, counted so operators (and the
-                # multichip dryrun) can see which regroup path actually ran.
-                counters.record(
-                    "bwls_regroup_skew_fallback",
-                    f"d*m_pad {plan.d * plan.m_pad} > 2*rows_out "
-                    f"{2 * plan.rows_out}: bucket padding beyond 2x optimal "
-                    "— taking the chunked-gather fallback",
-                )
+            counts = jnp.asarray(counts_np)
+            starts = jnp.asarray(starts_np)
+            # Segment ids: class of each sorted row, pad rows -> segment C.
+            seg_np = np.full(p_tot, n_classes, np.int32)
+            seg_np[:n] = class_idx[order]
+            seg_ids = jnp.asarray(seg_np)
+            counts_f = counts.astype(dtype)
 
-            chunk_cols = max(1, _GATHER_COL_CHUNK // max(1, x.itemsize))
-            if x.shape[1] <= chunk_cols:
-                g = jnp.take(x, gather_idx, axis=0, mode="fill", fill_value=0)
-                g = g * valid.astype(x.dtype)
-                return g if row_shard is None else jax.device_put(g, row_shard)
-            # Chunks land in a PREALLOCATED output via a donating
-            # dynamic-update-slice, so peak HBM is source + output + one
-            # chunk (~2x the design matrix).  The round-5 form accumulated
-            # all chunks in a list and concatenated — source + chunks +
-            # concat output, ~3x transient (ADVICE r5 medium).
-            out = jnp.zeros((p_tot, x.shape[1]), x.dtype)
-            if row_shard is not None:
-                out = jax.device_put(out, row_shard)
-            for c0 in range(0, x.shape[1], chunk_cols):
-                sl = jax.lax.slice_in_dim(
-                    x, c0, min(c0 + chunk_cols, x.shape[1]), axis=1
-                )
-                g = jnp.take(sl, gather_idx, axis=0, mode="fill", fill_value=0)
-                g = g * valid.astype(x.dtype)
-                if row_shard is not None:
-                    # Reshard each slab as it lands so at most one
-                    # unsharded chunk is transient at a time.
-                    g = jax.device_put(g, row_shard)
-                out = _scatter_cols(out, g, jnp.int32(c0))
-            return out
-
-        counts = jnp.asarray(counts_np)
-        starts = jnp.asarray(starts_np)
-        # Segment ids: class of each sorted row, pad rows -> segment C.
-        seg_np = np.full(p_tot, n_classes, np.int32)
-        seg_np[:n] = class_idx[order]
-        seg_ids = jnp.asarray(seg_np)
-        counts_f = counts.astype(dtype)
-
-        # jointLabelMean[c] = 2w + 2(1-w)·n_c/n − 1  (reference :147-149)
-        joint_label_mean = jnp.asarray(
-            2.0 * w + 2.0 * (1.0 - w) * counts_np / n - 1.0, dtype
-        )
-        valid_d = valid.astype(dtype)
-
-        chunk = max(1, min(self.class_chunk, n_classes))
-        if mesh is not None:
-            # Round the chunk up to a model-axis multiple so the batched
-            # class solves always shard over the model axis (pad classes in
-            # a partial chunk are repeats of class 0, discarded afterwards).
-            m_size = mesh.shape[MODEL_AXIS]
-            chunk = -(-chunk // m_size) * m_size
-
-        def sort_labels():
-            if isinstance(labels, jax.Array):
-                return sort_pad(labels.astype(dtype))
-            return sort_pad(np.asarray(labels, dtype))
-
-        if mesh is not None:
-            # Multi-chip path: per-chip admission of a GSPMD program is not
-            # modeled; the sharded fused program runs directly, as before.
-            self.last_fit_report = kmem.FitReport(
-                label="bwls_fit", chosen="fused[mesh]"
+            # jointLabelMean[c] = 2w + 2(1-w)·n_c/n − 1 (reference :147-149)
+            joint_label_mean = jnp.asarray(
+                2.0 * w + 2.0 * (1.0 - w) * counts_np / n - 1.0, dtype
             )
-            models_st, b = _fused_bwls_fit(
-                sort_pad(x), sort_labels(), valid_d, seg_ids, starts, counts,
-                counts_f, joint_label_mean, jnp.asarray(n),
-                jnp.asarray(self.lam, dtype), jnp.asarray(w, dtype),
-                self.num_iter, n_max, chunk, n_classes, widths, mesh,
+            valid_d = valid.astype(dtype)
+
+            chunk = max(1, min(self.class_chunk, n_classes))
+            if m is not None:
+                # Round the chunk up to a model-axis multiple so the
+                # batched class solves always shard over the model axis
+                # (pad classes in a partial chunk are repeats of class 0,
+                # discarded afterwards).
+                m_size = m.shape[MODEL_AXIS]
+                chunk = -(-chunk // m_size) * m_size
+
+            def sort_labels():
+                if isinstance(labels_src, jax.Array):
+                    return sort_pad(labels_src.astype(dtype))
+                return sort_pad(np.asarray(labels_src, dtype))
+
+            return _SolveCtx(
+                mesh=m,
+                p_tot=p_tot,
+                chunk=chunk,
+                sort_pad=sort_pad,
+                sort_labels=sort_labels,
+                valid_d=valid_d,
+                seg_ids=seg_ids,
+                starts=starts,
+                counts=counts,
+                counts_f=counts_f,
+                joint_label_mean=joint_label_mean,
+            )
+
+        if mesh is not None:
+            # Multi-chip path: the mesh degradation ladder — full
+            # (data, model) mesh with per-chip admission, then the
+            # model-axis-collapsed mesh, then the single-device ladder.
+            models_st, b = self._fit_mesh_ladder(
+                features, x, labels, prep, mesh, order, n, n_max,
+                n_classes, widths, dtype, donate,
             )
         else:
             models_st, b = self._fit_ladder(
-                features, x, labels, sort_pad, sort_labels, order, valid_d,
-                seg_ids, starts, counts, counts_f, joint_label_mean, n, n_max,
-                chunk, n_classes, widths, p_tot, dtype, donate,
+                features, x, labels, prep(None, labels), order, n, n_max,
+                n_classes, widths, dtype, donate,
             )
         model_list = [models_st[i, :wd] for i, wd in enumerate(widths)]
         return BlockLinearMapper(model_list, self.block_size, b)
 
+    def _fit_mesh_ladder(
+        self, features, x, labels, prep, mesh, order, n, n_max, n_classes,
+        widths, dtype, donate,
+    ):
+        """Distributed BWLS through the MESH degradation ladder: full
+        ``(data, model)`` mesh → model-axis-collapsed mesh (row-sharded
+        operands halve per chip, model state replicates) → the
+        single-device ladder on host-pulled inputs.  Each mesh tier builds
+        its own sort/pad layout (``prep(m, ...)``), is admitted PER CHIP
+        against the minimum free HBM across the mesh's devices, and a
+        runtime ``RESOURCE_EXHAUSTED`` from any chip steps down one tier.
+        ``report.mesh_shape`` records which mesh actually ran."""
+        bs, nb = max(widths), len(widths)
+        d_tot = nb * bs
+        it = np.dtype(dtype).itemsize
+        xdt = jax.dtypes.canonicalize_dtype(x.dtype)
+        report = kmem.FitReport(label="bwls_fit")
+        self.last_fit_report = report
+
+        def mesh_tier(m):
+            name = f"fused[mesh {mesh_desc(m)}]"
+            d_sz, m_sz = m.shape[DATA_AXIS], m.shape[MODEL_AXIS]
+            # Lazy, memoized: a tier's O(p_tot) gather/seg/mask buffers are
+            # only built once the ladder actually CONSIDERS the tier (the
+            # common admitted-first-tier fit never pays for the rungs
+            # below it — same laziness run_ladder gives the plans).
+            ctx_box: list = []
+
+            def ctx():
+                if not ctx_box:
+                    ctx_box.append(prep(m, labels))
+                return ctx_box[0]
+
+            def plan():
+                ctx_ = ctx()
+                budget, _worst = kmem.min_chip_budget(m)
+                sds = jax.ShapeDtypeStruct
+                i32 = jnp.int32
+                row = NamedSharding(m, P(DATA_AXIS, None))
+                x_s = sds((ctx_.p_tot, d_tot), xdt, sharding=row)
+                y_s = sds((ctx_.p_tot, n_classes), dtype, sharding=row)
+                # valid/seg/stat vectors are replicated — charged whole.
+                v_s = sds((ctx_.p_tot, 1), dtype)
+                seg_s = sds((ctx_.p_tot,), i32)
+                c_i32, c_f = sds((n_classes,), i32), sds((n_classes,), dtype)
+                sc_s, nv_s = sds((), dtype), sds((), i32)
+                # Analytic per-chip transient floor (CPU backends report
+                # temp 0): two row-sharded residual carries, one
+                # row-sharded block slice, the model-axis-sharded
+                # class-solve slab, the replicated stats/models stacks.
+                floor = it * (
+                    2 * ctx_.p_tot * n_classes // d_sz
+                    + ctx_.p_tot * bs // d_sz
+                    + ctx_.chunk * n_max * bs // m_sz
+                    + nb * (bs * bs + bs + n_classes * bs)
+                    + nb * bs * n_classes
+                )
+                return kmem.plan_program(
+                    _fused_bwls_fit_variant((0, 1)),
+                    x_s, y_s, v_s, seg_s, c_i32, c_i32, c_f, c_f, nv_s,
+                    sc_s, sc_s, self.num_iter, n_max, ctx_.chunk, n_classes,
+                    widths, m,
+                    label=f"bwls_{name}", budget=budget,
+                    min_temp_bytes=floor, mesh=m,
+                )
+
+            def run(plan):
+                ctx_ = ctx()
+                report.mesh_shape = dict(m.shape)
+                args = (
+                    ctx_.sort_pad(x), ctx_.sort_labels(), ctx_.valid_d,
+                    ctx_.seg_ids, ctx_.starts, ctx_.counts, ctx_.counts_f,
+                    ctx_.joint_label_mean, jnp.asarray(n),
+                    jnp.asarray(self.lam, dtype),
+                    jnp.asarray(self.mixture_weight, dtype),
+                )
+                statics = (
+                    self.num_iter, n_max, ctx_.chunk, n_classes, widths, m
+                )
+                # plan=None: the jitted sharded program, not the AOT plan
+                # executable (committed-sharding pitfalls — see
+                # block._execute_fused_bcd_mesh); same injection point.
+                return _execute_fused_bwls(None, args, statics)
+
+            return kmem.Tier(name, plan, run)
+
+        def plan_single():
+            return kmem.MemoryPlan(
+                label="single_device",
+                admitted=True,
+                reason=(
+                    "mesh ladder floor: single-device degradation ladder "
+                    "(its own per-tier admission runs inside)"
+                ),
+            )
+
+        inner_chosen = []
+
+        def run_single(_plan):
+            report.mesh_shape = None
+            x_h = (
+                np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x
+            )
+            y_h = (
+                np.asarray(jax.device_get(labels))
+                if isinstance(labels, jax.Array)
+                else labels
+            )
+            out = self._fit_ladder(
+                x_h, x_h, y_h, prep(None, y_h), order, n, n_max,
+                n_classes, widths, dtype, None, report=report,
+            )
+            inner_chosen.append(report.chosen)
+            return out
+
+        tiers = [mesh_tier(mesh)]
+        rm = reduced_mesh(mesh)
+        if rm is not None:
+            tiers.append(mesh_tier(rm))
+        tiers.append(kmem.Tier("single_device", plan_single, run_single))
+        out = kmem.run_ladder("bwls_fit", tiers, report)
+        if inner_chosen and report.chosen == "single_device":
+            report.chosen = f"single_device/{inner_chosen[0]}"
+        return out
+
     def _fit_ladder(
-        self, features, x, labels, sort_pad, sort_labels, order, valid_d,
-        seg_ids, starts, counts, counts_f, joint_label_mean, n, n_max, chunk,
-        n_classes, widths, p_tot, dtype, donate,
+        self, features, x, labels, ctx, order, n, n_max, n_classes, widths,
+        dtype, donate, report=None,
     ):
         """Single-device BWLS through the degradation ladder (preflight
         admission per tier; runtime RESOURCE_EXHAUSTED steps down one tier).
@@ -710,6 +912,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         The SORTED design matrix / labels are fit-private copies, so the
         fused program always donates them; ``donate=True`` additionally
         frees the caller's device inputs once sorted copies exist."""
+        sort_pad, sort_labels = ctx.sort_pad, ctx.sort_labels
+        valid_d, seg_ids = ctx.valid_d, ctx.seg_ids
+        starts, counts, counts_f = ctx.starts, ctx.counts, ctx.counts_f
+        joint_label_mean = ctx.joint_label_mean
+        chunk, p_tot = ctx.chunk, ctx.p_tot
         bs, nb = max(widths), len(widths)
         d_tot = nb * bs
         it = np.dtype(dtype).itemsize
@@ -825,7 +1032,10 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             return _execute_fused_bwls(plan, args, statics)
 
         def run_stepwise(plan):
+            from .block import _single_device_arrays
+
             xs, ls = sorted_device_inputs()
+            reusable = plan is not None and _single_device_arrays(xs, ls)
 
             def get_block(i):
                 return jax.lax.slice_in_dim(xs, i * bs, (i + 1) * bs, axis=1)
@@ -834,6 +1044,10 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 get_block, ls, valid_d, seg_ids, starts, counts, counts_f,
                 joint_label_mean, n, self.lam, self.mixture_weight,
                 self.num_iter, n_max, chunk, n_classes, widths,
+                # Reuse the preflight's AOT executable: the class-solve
+                # program compiled exactly once, at admission.  (Sharded
+                # inputs fall back to the jitted entry.)
+                class_solves=plan.compiled if reusable else None,
             )
 
         def run_host(plan):
@@ -860,14 +1074,22 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     np.ascontiguousarray(x_sorted_h[:, i * bs : (i + 1) * bs])
                 )
 
+            from .block import _single_device_arrays
+
             return _stepwise_bwls_fit(
                 get_block, ls, valid_d, seg_ids, starts, counts, counts_f,
                 joint_label_mean, n, self.lam, self.mixture_weight,
                 self.num_iter, n_max, chunk, n_classes, widths,
+                class_solves=(
+                    plan.compiled
+                    if plan is not None and _single_device_arrays(ls)
+                    else None
+                ),
             )
 
-        report = kmem.FitReport(label="bwls_fit", budget_bytes=budget)
-        self.last_fit_report = report
+        if report is None:
+            report = kmem.FitReport(label="bwls_fit", budget_bytes=budget)
+            self.last_fit_report = report
         return kmem.run_ladder(
             "bwls_fit",
             [

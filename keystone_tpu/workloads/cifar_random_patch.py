@@ -37,6 +37,7 @@ import numpy as np
 from ..core.logging import Logging, configure_logging
 from ..core.memory import log_fit_report
 from ..core.pipeline import Pipeline
+from ..core.resilience import assert_all_finite, numerics_guard_enabled
 from ..evaluation.multiclass import MulticlassClassifierEvaluator
 from ..loaders.cifar import LabeledImageBatch, cifar_loader
 from ..ops.conv_fused import FusedConvFeaturizer
@@ -75,6 +76,10 @@ class RandomCifarConfig:
     num_channels: int = 3
     whitener_size: int = 100000
     featurize_chunk: int = 2048
+    #: BCD solve fault tolerance (single-device fits only) — forwarded to
+    #: ``BlockLeastSquaresEstimator.fit(checkpoint=, resume_from=)``.
+    solve_checkpoint: object = None
+    solve_resume: object = None
 
 
 class _Log(Logging):
@@ -228,8 +233,16 @@ def run(
 
     labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
     solver = BlockLeastSquaresEstimator(4096, 1, conf.lam or 0.0, mesh=mesh)
-    model = solver.fit(train_features, labels)
+    model = solver.fit(
+        train_features,
+        labels,
+        checkpoint=conf.solve_checkpoint,
+        resume_from=conf.solve_resume,
+    )
     log_fit_report(solver, label="cifar random-patch solve")
+    if numerics_guard_enabled():
+        # Typed failure (FloatingPointError) instead of NaN predictions.
+        assert_all_finite(model, "cifar random-patch model")
 
     def predict(features):
         return MaxClassifier()(model(features))
@@ -249,6 +262,9 @@ def run(
     results = {
         "train_error": 100.0 * train_eval.total_error,
         "test_error": 100.0 * test_eval.total_error,
+        # Predicted labels on the test split — the chaos harness diffs
+        # these against the fault-free run to rule out silent wrong models.
+        "test_predictions": np.asarray(test_pred),
         "seconds": secs,
         "featurize_seconds": feat_secs,
         "featurize_images_per_sec": len(train) / feat_secs,

@@ -17,10 +17,12 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.logging import Logging, configure_logging
 from ..core.memory import log_fit_report
 from ..core.pipeline import Pipeline
+from ..core.resilience import assert_all_finite, numerics_guard_enabled
 from ..evaluation.multiclass import MulticlassClassifierEvaluator
 from ..loaders.csv_loader import LabeledData, csv_data_loader
 from ..ops.stats import LinearRectifier, PaddedFFT, RandomSignNode
@@ -41,6 +43,12 @@ class MnistRandomFFTConfig:
     seed: int = 0
     mnist_image_size: int = 784
     num_classes: int = 10
+    #: BCD solve fault tolerance (single-device fits only): a checkpoint
+    #: path/callback (state persisted after every completed block) and an
+    #: optional state to resume a preempted solve from — both forwarded to
+    #: ``BlockLeastSquaresEstimator.fit(checkpoint=, resume_from=)``.
+    solve_checkpoint: object = None
+    solve_resume: object = None
 
 
 def build_featurizer_batches(conf: MnistRandomFFTConfig):
@@ -101,8 +109,18 @@ def run(
     solver = BlockLeastSquaresEstimator(
         conf.block_size, 1, conf.lam or 0.0, mesh=mesh
     )
-    model = solver.fit(training_batches, labels, nvalid=nvalid)
+    model = solver.fit(
+        training_batches,
+        labels,
+        nvalid=nvalid,
+        checkpoint=conf.solve_checkpoint,
+        resume_from=conf.solve_resume,
+    )
     log_fit_report(solver, label="mnist random-fft solve")
+    if numerics_guard_enabled():
+        # Fail typed (FloatingPointError) instead of serving NaN scores —
+        # a poisoned batch or diverged solve must never look like a model.
+        assert_all_finite(model, "mnist random-fft model")
 
     test_batches = [
         ZipVectors.apply([chain(test_data) for chain in chains])
@@ -121,6 +139,10 @@ def run(
         predicted = MaxClassifier()(pred[:n_test])
         ev = MulticlassClassifierEvaluator(predicted, test.labels, conf.num_classes)
         results["test_error"] = 100.0 * ev.total_error
+        # Full-model predicted labels (the streaming evaluator's last call
+        # sees the complete model) — the chaos harness diffs these against
+        # the fault-free run to rule out silent wrong models.
+        results["test_predictions"] = np.asarray(predicted)
         log.log_info("TEST Error is %s%%", results["test_error"])
 
     # Streaming evaluation after each block, as the reference does (:70-86);
@@ -150,6 +172,17 @@ def main(argv=None):
         default=None,
         help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
     )
+    p.add_argument(
+        "--solveCheckpoint",
+        default=None,
+        help="path for resumable per-block BCD solve state (single-device "
+        "fits; state written atomically after every completed block)",
+    )
+    p.add_argument(
+        "--resumeFrom",
+        default=None,
+        help="BCD solve state path to resume a preempted fit from",
+    )
     a = p.parse_args(argv)
     if a.blockSize <= 0 or a.blockSize % 512 != 0:
         p.error("--blockSize must be a positive multiple of 512")
@@ -160,6 +193,8 @@ def main(argv=None):
         block_size=a.blockSize,
         lam=a.lam,
         seed=a.seed,
+        solve_checkpoint=a.solveCheckpoint,
+        solve_resume=a.resumeFrom,
     )
     # Labels in the files are 1-indexed (reference :40-42)
     train = LabeledData.from_rows(csv_data_loader(conf.train_location), one_indexed=True)

@@ -19,6 +19,7 @@ import numpy as np
 
 from ..core.logging import Logging, configure_logging
 from ..core.pipeline import Pipeline
+from ..core.resilience import assert_all_finite, numerics_guard_enabled
 from ..evaluation.multiclass import MulticlassClassifierEvaluator
 from ..loaders.cifar import LabeledImageBatch, cifar_loader
 from ..ops.images import (
@@ -104,6 +105,9 @@ def run(
 
     labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
     model = LinearMapEstimator(lam=conf.lam, mesh=mesh).fit(train_features, labels)
+    if numerics_guard_enabled():
+        # Typed failure (FloatingPointError) instead of NaN predictions.
+        assert_all_finite(model, "random-cifar model")
 
     def predict(features):
         return MaxClassifier()(model(features))

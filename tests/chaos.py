@@ -1,0 +1,513 @@
+"""Deterministic end-to-end chaos harness (NOT a test module — driven by
+tests/test_chaos.py in-suite and tools/chaos_run.py from the CLI).
+
+Spark subjected the reference to production chaos for free: task
+preemption, stragglers, flaky DFS reads, bad records.  This harness earns
+that hardness on purpose — a SEED maps to a fault schedule drawn from the
+injector families in tests/faults.py, the schedule is applied to a real
+workload pipeline (MnistRandomFFT or RandomPatchCifar on synthetic data),
+and the outcome is judged against one invariant:
+
+    every run either COMPLETES with predictions equal to the fault-free
+    run, or fails with a TYPED, COUNTED, LOGGED error — never a silent
+    wrong model, never a bare traceback.
+
+Fault families (``seed % len(FAMILIES)`` picks the family, the seeded rng
+draws its parameters — fully deterministic):
+
+* ``solver_oom`` / ``oom_cascade`` — injected RESOURCE_EXHAUSTED at fused
+  (and stepwise) dispatch: the degradation ladder must step down and the
+  degraded tiers must reproduce the fault-free predictions exactly.
+* ``io_transient`` — tar opens fail transiently during an image-tar ingest
+  phase: core.resilience.retry must absorb them (counted ``io_retry``).
+* ``corrupt_members`` — mangled JPEG members mid-archive: the loader must
+  skip-and-count each (``corrupt_image``), decode every survivor.
+* ``nan_input`` — NaN poisoning of the training batch: the workload's
+  finite-model guard must fail TYPED (FloatingPointError), counted.
+* ``preempt_resume`` — a simulated preemption mid-BCD (after a completed
+  block checkpoint) followed by a ``resume_from=`` restart that must land
+  on the fault-free predictions.
+* ``deadline`` — an injected hang in the solve, bounded by
+  ``resilience.deadline``: the run must die with a typed
+  ``DeadlineExceeded`` naming the phase (counted ``deadline_exceeded``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+import faults
+
+from keystone_tpu.core import checkpoint as ckpt_mod
+from keystone_tpu.core import memory as kmem
+from keystone_tpu.core.resilience import (
+    DeadlineExceeded,
+    counters,
+    deadline,
+)
+from keystone_tpu.loaders import image_loaders
+from keystone_tpu.loaders.cifar import cifar_loader
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.solvers import block as block_mod
+from keystone_tpu.solvers.block import bcd_checkpoint_writer
+
+#: Exception types that count as a STRUCTURED failure — anything else
+#: escaping a chaos run is a bare traceback, i.e. a harness violation.
+TYPED_ERRORS = (
+    FloatingPointError,
+    DeadlineExceeded,
+    ckpt_mod.CheckpointError,  # includes CheckpointMismatch
+    kmem.LadderSourceLost,
+)
+
+FAMILIES = (
+    "solver_oom",
+    "oom_cascade",
+    "io_transient",
+    "corrupt_members",
+    "nan_input",
+    "preempt_resume",
+    "deadline",
+)
+
+#: Seeds the tier-1 suite runs (small schedule, covers every family);
+#: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
+TIER1_SEEDS = tuple(range(10))
+FULL_SEEDS = tuple(range(21))
+
+_DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
+_N_TAR_IMAGES = 6
+
+
+class SimulatedPreemption(RuntimeError):
+    """Injected mid-fit preemption (the chaos analog of a TPU VM being
+    reclaimed between BCD blocks) — expected and consumed by the
+    ``preempt_resume`` schedule, never a final outcome."""
+
+
+class ChaosOracleError(AssertionError):
+    """The resilience contract itself broke (wrong skip count, missing
+    expected failure, survivors lost) — surfaces as a failed outcome."""
+
+
+@dataclasses.dataclass
+class Fault:
+    kind: str
+    params: dict
+
+    def record(self) -> dict:
+        return {"kind": self.kind, **self.params}
+
+
+@dataclasses.dataclass
+class ChaosResult:
+    seed: int
+    workload: str
+    fault: Fault
+    outcome: str  # completed_equal | typed_error | SILENT_WRONG_MODEL |
+    #             UNTYPED_ERROR | ORACLE_FAILED
+    error_type: str | None = None
+    error: str | None = None
+    phase: str | None = None
+    counters_delta: dict = dataclasses.field(default_factory=dict)
+    seconds: float = 0.0
+
+    def ok(self) -> bool:
+        return self.outcome in ("completed_equal", "typed_error")
+
+    def record(self) -> dict:
+        return {
+            "seed": self.seed,
+            "workload": self.workload,
+            "fault": self.fault.record(),
+            "outcome": self.outcome,
+            "error_type": self.error_type,
+            "error": self.error[:200] if self.error else None,
+            "phase": self.phase,
+            "counters_delta": dict(self.counters_delta),
+            "seconds": round(self.seconds, 3),
+        }
+
+
+def make_schedule(seed: int) -> Fault:
+    """seed -> fault schedule, deterministically: the family cycles so any
+    contiguous seed range covers all of them, the parameters are drawn
+    from ``default_rng(seed)``."""
+    rng = np.random.default_rng(seed)
+    kind = FAMILIES[seed % len(FAMILIES)]
+    if kind == "solver_oom":
+        return Fault(kind, {"failures": 1})
+    if kind == "oom_cascade":
+        return Fault(kind, {"failures": 2})
+    if kind == "io_transient":
+        return Fault(kind, {"io_failures": int(rng.integers(1, 3))})
+    if kind == "corrupt_members":
+        k = int(rng.integers(1, 4))
+        corrupt = tuple(
+            sorted(int(i) for i in rng.choice(_N_TAR_IMAGES, k, replace=False))
+        )
+        return Fault(kind, {"corrupt": corrupt})
+    if kind == "nan_input":
+        return Fault(kind, {"frac": float(rng.uniform(0.002, 0.02))})
+    if kind == "preempt_resume":
+        return Fault(kind, {"preempt_after_blocks": 1})
+    return Fault("deadline", {"seconds": 1.0})
+
+
+# -- workload cases -----------------------------------------------------------
+
+
+def _mnist_case():
+    rng = np.random.default_rng(_DATA_SEED)
+    d, k = 64, 5
+    centers = rng.normal(size=(k, d))
+
+    def split(n):
+        labels = rng.integers(0, k, n)
+        data = (centers[labels] + 0.3 * rng.normal(size=(n, d))).astype(
+            np.float32
+        )
+        return LabeledData(data=data, labels=labels.astype(np.int32))
+
+    return split(160), split(80)
+
+
+_mnist_data_cache: list = []
+
+
+def _run_mnist(train_override=None, **conf_kw):
+    from keystone_tpu.workloads.mnist_random_fft import (
+        MnistRandomFFTConfig,
+        run,
+    )
+
+    if not _mnist_data_cache:
+        _mnist_data_cache.append(_mnist_case())
+    train, test = _mnist_data_cache[0]
+    if train_override is not None:
+        train = train_override(train)
+    conf = MnistRandomFFTConfig(
+        num_ffts=2,
+        block_size=512,
+        lam=1e-2,
+        mnist_image_size=64,
+        num_classes=5,
+        **conf_kw,
+    )
+    return run(conf, train, test)
+
+
+_cifar_paths_cache: list = []
+
+
+def _write_synthetic_cifar(path, n, rng, num_classes=4, base=None):
+    """Class-colored blobs + noise in CIFAR binary record format."""
+    from keystone_tpu.loaders.cifar import RECORD_BYTES
+
+    labels = rng.integers(0, num_classes, n).astype(np.uint8)
+    if base is None:
+        base = rng.uniform(40, 215, (num_classes, 3))
+    recs = np.zeros((n, RECORD_BYTES), np.uint8)
+    yy, xx = np.mgrid[0:32, 0:32]
+    del yy
+    for i in range(n):
+        img = base[labels[i]][:, None, None] + rng.normal(0, 25, (3, 32, 32))
+        img[labels[i] % 3] += 30 * np.sin(xx / (2.0 + labels[i]))
+        recs[i, 0] = labels[i]
+        recs[i, 1:] = np.clip(img, 0, 255).astype(np.uint8).reshape(-1)
+    recs.tofile(path)
+
+
+def _run_cifar(train_override=None, **conf_kw):
+    from keystone_tpu.workloads.cifar_random_patch import (
+        RandomCifarConfig,
+        run,
+    )
+
+    if not _cifar_paths_cache:
+        d = tempfile.mkdtemp(prefix="chaos_cifar_")
+        rng = np.random.default_rng(_DATA_SEED)
+        palette = rng.uniform(40, 215, (4, 3))
+        tr, te = os.path.join(d, "train.bin"), os.path.join(d, "test.bin")
+        _write_synthetic_cifar(tr, 72, rng, base=palette)
+        _write_synthetic_cifar(te, 36, rng, base=palette)
+        _cifar_paths_cache.append((tr, te))
+    tr, te = _cifar_paths_cache[0]
+    conf = RandomCifarConfig(
+        num_filters=8,
+        patch_size=6,
+        patch_steps=4,
+        lam=10.0,
+        whitener_size=300,
+        featurize_chunk=36,
+        num_classes=4,
+        **conf_kw,
+    )
+    train, test = cifar_loader(tr), cifar_loader(te)
+    if train_override is not None:
+        train = train_override(train)
+    return run(conf, train, test)
+
+
+def _run_workload(workload: str, train_override=None, **conf_kw):
+    if workload == "mnist":
+        return _run_mnist(train_override=train_override, **conf_kw)
+    if workload == "cifar":
+        return _run_cifar(train_override=train_override, **conf_kw)
+    raise ValueError(f"unknown chaos workload {workload!r}")
+
+
+_baselines: dict[str, dict] = {}
+
+
+def baseline(workload: str) -> dict:
+    """The fault-free run every schedule is judged against (cached — one
+    per workload per process; also pre-warms every jit cache so faulted
+    runs measure fault handling, not compilation)."""
+    if workload not in _baselines:
+        _baselines[workload] = _run_workload(workload)
+    return _baselines[workload]
+
+
+def _preds_equal(a, b) -> bool:
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and bool(np.array_equal(a, b))
+
+
+@contextlib.contextmanager
+def _patched(obj, attr, replacement):
+    original = getattr(obj, attr)
+    setattr(obj, attr, replacement)
+    try:
+        yield
+    finally:
+        setattr(obj, attr, original)
+
+
+@contextlib.contextmanager
+def _clean_env():
+    """Chaos runs start from the default resilience posture: no HBM budget
+    override (ladders start at the fused tier) and the numerics guard on."""
+    saved = {
+        k: os.environ.pop(k, None)
+        for k in (kmem.HBM_BUDGET_ENV, "KEYSTONE_NUMERICS_GUARD")
+    }
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# -- the per-family drivers ---------------------------------------------------
+
+
+def _ingest_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """The tar-ingest chaos phase (io_transient / corrupt_members): build a
+    seeded JPEG tar (optionally with mangled members), stream-decode it
+    under the injected faults, and hold the loader to its contract —
+    every survivor decoded in order, every corrupt member a COUNTED skip."""
+    rng = np.random.default_rng(seed)
+    tar_path = os.path.join(tmpdir, f"chaos_ingest_{seed}.tar")
+    corrupt = tuple(fault.params.get("corrupt", ()))
+    names = faults.make_image_tar(
+        tar_path, _N_TAR_IMAGES, rng, corrupt=corrupt
+    )
+    before_skip = counters.get("corrupt_image")
+    before_retry = counters.get("io_retry")
+    io_failures = int(fault.params.get("io_failures", 0))
+    ctx = (
+        faults.transient_faults(image_loaders.tarfile, "open", io_failures)
+        if io_failures
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        decoded = [
+            name
+            for name, _img in image_loaders._iter_tar_images(
+                tar_path, num_threads=1
+            )
+        ]
+    survivors = [n for i, n in enumerate(names) if i not in corrupt]
+    if decoded != survivors:
+        raise ChaosOracleError(
+            f"ingest lost data: decoded {decoded} != survivors {survivors}"
+        )
+    skipped = counters.get("corrupt_image") - before_skip
+    if skipped != len(corrupt):
+        raise ChaosOracleError(
+            f"{len(corrupt)} corrupt member(s) but {skipped} counted skips — "
+            "a corrupt member was swallowed uncounted"
+        )
+    if io_failures and counters.get("io_retry") - before_retry < io_failures:
+        raise ChaosOracleError(
+            f"{io_failures} injected open failure(s) but fewer io_retry "
+            "counts — a transient fault was absorbed invisibly"
+        )
+
+
+def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
+    """Apply one schedule to the workload; returns the results dict (or
+    raises).  Each branch is the minimal faithful injection for its
+    family — all patches restored on exit."""
+    if fault.kind == "solver_oom":
+        with faults.oom_faults(
+            block_mod, "_execute_fused_bcd", failures=fault.params["failures"]
+        ):
+            return _run_workload(workload)
+
+    if fault.kind == "oom_cascade":
+        # Fused dies, then the stepwise per-block solve dies too: the
+        # ladder must walk fused -> stepwise -> host_staged.
+        with faults.oom_faults(block_mod, "_execute_fused_bcd", failures=1):
+            with faults.oom_faults(block_mod, "_bcd_block_solve", failures=1):
+                return _run_workload(workload)
+
+    if fault.kind in ("io_transient", "corrupt_members"):
+        _ingest_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "nan_input":
+        frac = fault.params["frac"]
+        rng = np.random.default_rng(seed)
+
+        def poison(train):
+            if hasattr(train, "data"):  # LabeledData
+                return dataclasses.replace(
+                    train, data=faults.inject_nan(train.data, rng, frac)
+                )
+            return dataclasses.replace(  # LabeledImageBatch
+                train, images=faults.inject_nan(train.images, rng, frac)
+            )
+
+        return _run_workload(workload, train_override=poison)
+
+    if fault.kind == "preempt_resume":
+        ckpt_path = os.path.join(tmpdir, f"chaos_bcd_{workload}_{seed}")
+        writer = bcd_checkpoint_writer(ckpt_path)
+        after = int(fault.params["preempt_after_blocks"])
+        calls = {"n": 0}
+
+        def preempting_cb(state):
+            writer(state)
+            calls["n"] += 1
+            if calls["n"] >= after:
+                raise SimulatedPreemption(
+                    f"injected preemption after block {state['block']} "
+                    f"of epoch {state['epoch']}"
+                )
+
+        try:
+            _run_workload(workload, solve_checkpoint=preempting_cb)
+        except SimulatedPreemption:
+            pass
+        else:
+            raise ChaosOracleError(
+                "preemption callback never fired — the checkpointing "
+                "stepwise path was not taken"
+            )
+        counters.record(
+            "chaos_preemption", f"{workload} seed {seed}: resuming from "
+            f"{ckpt_path}"
+        )
+        return _run_workload(
+            workload,
+            solve_checkpoint=ckpt_path,
+            solve_resume=ckpt_path,
+        )
+
+    if fault.kind == "deadline":
+        budget = float(fault.params["seconds"])
+        real = block_mod._execute_fused_bcd
+
+        def hanging_execute(*a, **kw):
+            time.sleep(600.0)  # interrupted by the deadline watchdog
+            return real(*a, **kw)
+
+        with _patched(block_mod, "_execute_fused_bcd", hanging_execute):
+            with deadline(budget, phase="solve"):
+                return _run_workload(workload)
+
+    raise ValueError(f"unknown fault family {fault.kind!r}")
+
+
+def expected_outcome(fault: Fault) -> str:
+    """What a HEALTHY system does under this schedule."""
+    if fault.kind in ("nan_input", "deadline"):
+        return "typed_error"
+    return "completed_equal"
+
+
+def run_schedule(seed: int, workload: str = "mnist", tmpdir: str | None = None) -> ChaosResult:
+    """Run ONE seeded fault schedule end-to-end and judge the outcome."""
+    fault = make_schedule(seed)
+    own_tmp = tmpdir is None
+    if own_tmp:
+        tmpdir = tempfile.mkdtemp(prefix="chaos_")
+    t0 = time.monotonic()
+    result = ChaosResult(seed=seed, workload=workload, fault=fault, outcome="")
+    with _clean_env():
+        base = baseline(workload)
+        before = counters.counts()
+        try:
+            res = _run_faulted(fault, workload, tmpdir, seed)
+        except TYPED_ERRORS as e:
+            result.outcome = "typed_error"
+            result.error_type = type(e).__name__
+            result.error = str(e)
+            result.phase = getattr(e, "phase", None)
+        except ChaosOracleError as e:
+            result.outcome = "ORACLE_FAILED"
+            result.error_type = type(e).__name__
+            result.error = str(e)
+        except Exception as e:  # noqa: BLE001 — the contract violation case
+            result.outcome = "UNTYPED_ERROR"
+            result.error_type = type(e).__name__
+            result.error = str(e)
+        else:
+            got = res.get("test_predictions")
+            want = base.get("test_predictions")
+            if got is None or want is None:
+                # A missing prediction vector must never score as equal —
+                # that would be the oracle passing vacuously.
+                result.outcome = "ORACLE_FAILED"
+                result.error = (
+                    "no test_predictions to compare "
+                    f"(faulted: {got is not None}, baseline: {want is not None})"
+                )
+            elif _preds_equal(got, want):
+                result.outcome = "completed_equal"
+            else:
+                result.outcome = "SILENT_WRONG_MODEL"
+                result.error = (
+                    "run completed but predictions differ from the "
+                    "fault-free baseline"
+                )
+        after = counters.counts()
+        result.counters_delta = {
+            k: after[k] - before.get(k, 0)
+            for k in after
+            if after[k] != before.get(k, 0)
+        }
+    result.seconds = time.monotonic() - t0
+    if own_tmp:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return result
+
+
+def run_suite(seeds, workload: str = "mnist") -> list[ChaosResult]:
+    tmpdir = tempfile.mkdtemp(prefix="chaos_suite_")
+    try:
+        return [run_schedule(s, workload=workload, tmpdir=tmpdir) for s in seeds]
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)

@@ -53,3 +53,10 @@ def rng():
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running end-to-end pipeline test")
+    config.addinivalue_line(
+        "markers",
+        "chaos: full seeded fault-schedule suite (tests/chaos.py) — the "
+        "tier-1 run covers a small schedule; select the full set with "
+        "-m chaos (full-schedule tests are also marked slow so the tier-1 "
+        "'-m not slow' filter excludes them)",
+    )

@@ -167,8 +167,13 @@ def oom_faults(obj, attr: str, failures: int = 1):
 
 
 def inject_nan(batch, rng, frac: float = 0.01):
-    """Copy of ``batch`` with ~``frac`` of entries replaced by NaN."""
-    out = np.array(batch, copy=True)
+    """Copy of ``batch`` with ~``frac`` of entries replaced by NaN.
+
+    ``order="C"`` matters: the default ``np.array`` copy preserves the
+    source's memory layout, and on a transposed input (e.g. the CIFAR
+    loader's NHWC images) ``reshape(-1)`` of that layout is a COPY — the
+    NaN writes would be silently discarded and the injection a no-op."""
+    out = np.array(batch, copy=True, order="C")
     flat = out.reshape(-1)
     k = max(1, int(frac * flat.size))
     idx = rng.choice(flat.size, k, replace=False)

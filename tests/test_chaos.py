@@ -1,0 +1,98 @@
+"""Seeded chaos suite: every fault schedule must end in either
+predictions-equal-to-fault-free or a typed, counted error — never a
+silent wrong model, never a bare traceback (see tests/chaos.py).
+
+Tier-1 runs ``chaos.TIER1_SEEDS`` on the MNIST pipeline (plus two
+schedules on the conv CIFAR pipeline); the full seed set runs only under
+``-m chaos`` (it is also marked slow so the tier-1 ``-m 'not slow'``
+filter keeps excluding it).
+"""
+
+import pytest
+
+import chaos
+
+#: The counter each family must bump — faults are COUNTED, not just
+#: survived, so operators can see them (the "structured, counted, logged"
+#: leg of the chaos invariant).
+EXPECTED_COUNTER = {
+    "solver_oom": "solver_oom_retry",
+    "oom_cascade": "solver_oom_retry",
+    "io_transient": "io_retry",
+    "corrupt_members": "corrupt_image",
+    "nan_input": "nonfinite_model",
+    "preempt_resume": "chaos_preemption",
+    "deadline": "deadline_exceeded",
+}
+
+
+def _check(r):
+    assert r.ok(), r.record()
+    assert r.outcome == chaos.expected_outcome(r.fault), r.record()
+    counter = EXPECTED_COUNTER[r.fault.kind]
+    assert r.counters_delta.get(counter, 0) >= 1, (
+        f"schedule survived but its fault went uncounted "
+        f"({counter} delta 0): {r.record()}"
+    )
+
+
+@pytest.mark.parametrize("seed", chaos.TIER1_SEEDS)
+def test_chaos_schedule_mnist(seed, tmp_path):
+    _check(chaos.run_schedule(seed, "mnist", tmpdir=str(tmp_path)))
+
+
+@pytest.mark.parametrize("seed", (0, 4))  # OOM step-down + NaN guard
+def test_chaos_schedule_cifar(seed, tmp_path):
+    _check(chaos.run_schedule(seed, "cifar", tmpdir=str(tmp_path)))
+
+
+def test_tier1_seed_set_meets_the_chaos_bar():
+    """The in-tier-1 schedule set is the acceptance floor: >= 10 seeded
+    schedules covering EVERY fault family, including one
+    preempt-then-resume and one deadline/watchdog trip."""
+    assert len(chaos.TIER1_SEEDS) >= 10
+    kinds = {chaos.make_schedule(s).kind for s in chaos.TIER1_SEEDS}
+    assert kinds == set(chaos.FAMILIES)
+    assert {"preempt_resume", "deadline"} <= kinds
+
+
+def test_schedules_are_deterministic():
+    for seed in chaos.TIER1_SEEDS:
+        a, b = chaos.make_schedule(seed), chaos.make_schedule(seed)
+        assert a.kind == b.kind and a.params == b.params
+
+
+def test_deadline_names_the_phase(tmp_path):
+    """The watchdog schedule's error must carry the phase name — a hang
+    report that cannot say WHAT hung is barely better than the hang."""
+    seed = next(
+        s for s in chaos.TIER1_SEEDS
+        if chaos.make_schedule(s).kind == "deadline"
+    )
+    r = chaos.run_schedule(seed, "mnist", tmpdir=str(tmp_path))
+    assert r.error_type == "DeadlineExceeded"
+    assert r.phase == "solve"
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_full_schedule_mnist():
+    results = chaos.run_suite(chaos.FULL_SEEDS, workload="mnist")
+    bad = [
+        r.record()
+        for r in results
+        if not r.ok() or r.outcome != chaos.expected_outcome(r.fault)
+    ]
+    assert not bad, bad
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_full_schedule_cifar():
+    results = chaos.run_suite(chaos.FULL_SEEDS[: len(chaos.FAMILIES)], workload="cifar")
+    bad = [
+        r.record()
+        for r in results
+        if not r.ok() or r.outcome != chaos.expected_outcome(r.fault)
+    ]
+    assert not bad, bad

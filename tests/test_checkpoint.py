@@ -251,6 +251,103 @@ class TestCheckpointContract:
         _assert_leaves_bit_identical(node, loaded)
 
 
+class TestTopologyGuard:
+    """Mesh-aware restore safety: a checkpoint holding SHARDED arrays must
+    refuse (typed CheckpointMismatch) to load onto a different device/mesh
+    topology; fully-replicated checkpoints stay portable."""
+
+    def _edit_topology(self, stem, **changes):
+        with open(stem + ".json") as fh:
+            manifest = json.load(fh)
+        manifest["topology"].update(changes)
+        with open(stem + ".json", "w") as fh:
+            json.dump(manifest, fh)
+
+    def test_topology_and_replication_recorded(self, tmp_path, rng, mesh8):
+        pca = PCATransformer(jnp.asarray(rng.normal(size=(4, 2)), jnp.float32))
+        stem = save_pipeline(str(tmp_path / "topo"), pca)
+        with open(stem + ".json") as fh:
+            manifest = json.load(fh)
+        topo = manifest["topology"]
+        assert topo["platform"] == "cpu" and topo["device_count"] == 8
+        assert manifest["all_replicated"] is True
+
+        from keystone_tpu.parallel.mesh import row_sharding, use_mesh
+
+        sharded = PCATransformer(
+            jax.device_put(
+                jnp.asarray(rng.normal(size=(16, 2)), jnp.float32),
+                row_sharding(mesh8),
+            )
+        )
+        with use_mesh(mesh8):
+            stem2 = save_pipeline(str(tmp_path / "topo_sharded"), sharded)
+        with open(stem2 + ".json") as fh:
+            manifest2 = json.load(fh)
+        assert manifest2["all_replicated"] is False
+        assert manifest2["topology"]["mesh"] == {"data": 8, "model": 1}
+
+    def test_sharded_checkpoint_rejects_foreign_topology(
+        self, tmp_path, rng, mesh8
+    ):
+        from keystone_tpu.core.checkpoint import CheckpointMismatch
+        from keystone_tpu.parallel.mesh import row_sharding
+
+        node = PCATransformer(
+            jax.device_put(
+                jnp.asarray(rng.normal(size=(16, 2)), jnp.float32),
+                row_sharding(mesh8),
+            )
+        )
+        stem = save_pipeline(str(tmp_path / "foreign"), node)
+        self._edit_topology(stem, device_count=16)
+        with pytest.raises(CheckpointMismatch, match="topology"):
+            load_pipeline(stem)
+        # The typed mismatch is still a CheckpointError for callers that
+        # catch broadly (load_or_fit error paths).
+        assert issubclass(CheckpointMismatch, CheckpointError)
+
+    def test_sharded_checkpoint_rejects_different_mesh(
+        self, tmp_path, rng, mesh8
+    ):
+        from keystone_tpu.core.checkpoint import CheckpointMismatch
+        from keystone_tpu.parallel.mesh import row_sharding
+
+        node = PCATransformer(
+            jax.device_put(
+                jnp.asarray(rng.normal(size=(16, 2)), jnp.float32),
+                row_sharding(mesh8),
+            )
+        )
+        stem = save_pipeline(str(tmp_path / "mesh_drift"), node)
+        self._edit_topology(stem, mesh={"data": 4, "model": 2})
+        with pytest.raises(CheckpointMismatch, match="reshard"):
+            load_pipeline(stem)
+
+    def test_replicated_checkpoint_loads_across_topologies(
+        self, tmp_path, rng
+    ):
+        node = PCATransformer(jnp.asarray(rng.normal(size=(4, 2)), jnp.float32))
+        stem = save_pipeline(str(tmp_path / "portable"), node)
+        self._edit_topology(stem, device_count=1024, platform="tpu")
+        loaded = load_pipeline(stem)  # replicated state is portable
+        _assert_leaves_bit_identical(node, loaded)
+
+    def test_pre_guard_manifest_still_loads(self, tmp_path, rng):
+        """Backward compat: manifests written before the topology guard
+        (no ``topology`` key) load with a warning, not a crash."""
+        node = PCATransformer(jnp.asarray(rng.normal(size=(4, 2)), jnp.float32))
+        stem = save_pipeline(str(tmp_path / "old"), node)
+        with open(stem + ".json") as fh:
+            manifest = json.load(fh)
+        del manifest["topology"]
+        del manifest["all_replicated"]
+        with open(stem + ".json", "w") as fh:
+            json.dump(manifest, fh)
+        loaded = load_pipeline(stem)
+        _assert_leaves_bit_identical(node, loaded)
+
+
 class TestFreshProcessReload:
     def test_predictions_identical_in_fresh_process(self, tmp_path, rng):
         """fit -> save -> reload in a NEW interpreter -> identical scores."""

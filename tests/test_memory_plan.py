@@ -13,6 +13,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from faults import oom_faults, resource_exhausted_error
 
@@ -248,6 +249,25 @@ class TestBcdLadder:
         assert counters.get("solver_oom_retry") == before + 1
         np.testing.assert_allclose(p_clean, p_retry, rtol=1e-5, atol=1e-5)
 
+    def test_sharded_inputs_without_mesh_fall_back_to_jit(
+        self, rng, mesh8, monkeypatch
+    ):
+        """A mesh-less fit handed row-SHARDED caller arrays while a budget
+        is set must not crash on the single-device AOT executable (its
+        baked placements reject sharded inputs) — the executor falls back
+        to the jitted variant and the result matches the unsharded fit."""
+        from keystone_tpu.parallel.mesh import padded_shard_rows
+
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, "1G")
+        a, b = _problem(rng)
+        _, p_clean = _fit(a, b)
+        a_sh, n = padded_shard_rows(a, mesh8)
+        b_sh, _ = padded_shard_rows(b, mesh8)
+        est = BlockLeastSquaresEstimator(BS, num_iter=2, lam=0.5)
+        model = est.fit(a_sh, b_sh, nvalid=n)
+        preds = np.asarray(model(jnp.asarray(a)))
+        np.testing.assert_allclose(preds, p_clean, rtol=1e-4, atol=1e-4)
+
     def test_non_oom_failure_propagates(self, rng, monkeypatch):
         monkeypatch.delenv(kmem.HBM_BUDGET_ENV, raising=False)
         a, b = _problem(rng)
@@ -367,4 +387,259 @@ class TestReportPlumbing:
         b = rng.normal(size=(24, 4)).astype(np.float32)
         est = BlockLeastSquaresEstimator(8, num_iter=1, lam=0.1, mesh=mesh8)
         est.fit(a, b)
-        assert est.last_fit_report.chosen == "fused[mesh]"
+        assert est.last_fit_report.chosen == "fused[mesh 8x1]"
+        assert est.last_fit_report.mesh_shape == {"data": 8, "model": 1}
+
+
+class TestAotReuse:
+    """ROADMAP leftover from PR 2: the degraded stepwise tier must execute
+    the preflight's AOT-compiled per-block executable, not recompile it at
+    first jit dispatch — asserted via the plan compile-counter AND the jit
+    dispatch cache staying untouched."""
+
+    def test_bcd_stepwise_compiles_per_block_program_exactly_once(
+        self, rng, monkeypatch
+    ):
+        a, b = _problem(rng)
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, "1G")
+        _, p_clean = _fit(a, b)
+        est0, _ = _fit(a, b)
+        f_tot = est0.last_fit_report.plans["fused"].total_bytes
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, str(f_tot - 1))
+
+        kmem.clear_plan_cache()
+        compiles0 = kmem.compile_count("bcd_stepwise")
+        jit_cache0 = block_mod._bcd_block_solve._cache_size()
+        est, p_step = _fit(a, b)
+        assert est.last_fit_report.chosen == "stepwise"
+        # Exactly ONE compile of the per-block solve: the preflight's.
+        assert kmem.compile_count("bcd_stepwise") == compiles0 + 1
+        # ...and no second compile at jit dispatch on the degraded path.
+        assert block_mod._bcd_block_solve._cache_size() == jit_cache0
+        np.testing.assert_allclose(p_clean, p_step, rtol=1e-5, atol=1e-5)
+
+        # A refit reuses the cached plan executable: zero new compiles.
+        est2, _ = _fit(a, b)
+        assert est2.last_fit_report.chosen == "stepwise"
+        assert kmem.compile_count("bcd_stepwise") == compiles0 + 1
+        assert block_mod._bcd_block_solve._cache_size() == jit_cache0
+
+    def test_bwls_stepwise_reuses_preflight_executable(self, rng, monkeypatch):
+        n, d, c = 96, 256, 8
+        cls = rng.integers(0, c, n)
+        x = (rng.normal(size=(n, d)) + 0.1 * cls[:, None]).astype(np.float32)
+        y = (2.0 * np.eye(c)[cls] - 1.0).astype(np.float32)
+
+        def fit():
+            est = BlockWeightedLeastSquaresEstimator(
+                32, num_iter=2, lam=0.1, mixture_weight=0.5
+            )
+            model = est.fit(x, y)
+            return est, np.asarray(model(jnp.asarray(x)))
+
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, "1G")
+        est0, p_clean = fit()
+        f_tot = est0.last_fit_report.plans["fused"].total_bytes
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, str(f_tot - 1))
+
+        kmem.clear_plan_cache()
+        compiles0 = kmem.compile_count("bwls_stepwise")
+        jit_cache0 = weighted_mod._class_solves._cache_size()
+        est, p_step = fit()
+        assert est.last_fit_report.chosen == "stepwise"
+        assert kmem.compile_count("bwls_stepwise") == compiles0 + 1
+        assert weighted_mod._class_solves._cache_size() == jit_cache0
+        np.testing.assert_allclose(p_clean, p_step, rtol=1e-5, atol=1e-5)
+
+
+class TestMeshAdmission:
+    """Per-chip admission math for GSPMD programs on the forced-8-device
+    CPU host: per-axis sharded operand division, conservative replicated
+    accounting, minimum-free-chip budgets, and the XLA ground-truth
+    cross-check."""
+
+    def test_shard_bytes_divides_by_named_axes(self, mesh42):
+        full = 64 * 32 * 4
+        row = jax.ShapeDtypeStruct(
+            (64, 32), jnp.float32,
+            sharding=NamedSharding(mesh42, P("data", None)),
+        )
+        both = jax.ShapeDtypeStruct(
+            (64, 32), jnp.float32,
+            sharding=NamedSharding(mesh42, P("data", "model")),
+        )
+        repl = jax.ShapeDtypeStruct(
+            (64, 32), jnp.float32, sharding=NamedSharding(mesh42, P())
+        )
+        bare = jax.ShapeDtypeStruct((64, 32), jnp.float32)
+        assert kmem.shard_bytes(row) == full // 4
+        assert kmem.shard_bytes(both) == full // 8
+        assert kmem.shard_bytes(repl) == full  # replicated charges whole
+        assert kmem.shard_bytes(bare) == full  # un-annotated: conservative
+
+    def test_sharded_vs_replicated_operand_accounting(self, mesh42):
+        """A row-sharded operand charges its shard; a replicated operand
+        charges full size on every chip — and XLA's per-device module
+        accounting (plan.reported) agrees exactly on this program."""
+        fn = jax.jit(lambda x, y: x @ y)
+        x_s = jax.ShapeDtypeStruct(
+            (64, 32), jnp.float32,
+            sharding=NamedSharding(mesh42, P("data", None)),
+        )
+        y_s = jax.ShapeDtypeStruct((32, 16), jnp.float32)  # replicated
+        plan = kmem.plan_program(
+            fn, x_s, y_s, label="mesh_acct", budget=1 << 30, mesh=mesh42
+        )
+        assert plan.analyzed and plan.admitted
+        assert plan.mesh_axes == {"data": 4, "model": 2}
+        assert plan.argument_bytes == (64 * 32 * 4) // 4 + 32 * 16 * 4
+        assert plan.reported["argument"] == plan.argument_bytes
+        assert "per-chip" in plan.reason and "min-free-chip" in plan.reason
+        bd = plan.breakdown()
+        assert bd["per_chip"] is True and bd["mesh"] == {"data": 4, "model": 2}
+        assert "xla_reported_gb" in bd
+
+    def test_min_chip_budget_takes_the_worst_chip(self, mesh42, monkeypatch):
+        monkeypatch.delenv(kmem.HBM_BUDGET_ENV, raising=False)
+        devices = list(mesh42.devices.flat)
+        frees = {d.id: 10**9 for d in devices}
+        tight = devices[3]
+        frees[tight.id] = 12345
+        monkeypatch.setattr(
+            kmem, "hbm_budget", lambda device=None: frees[device.id]
+        )
+        budget, dev = kmem.min_chip_budget(mesh42)
+        assert budget == 12345 and dev.id == tight.id
+
+    def test_min_chip_budget_env_override_is_per_chip(self, mesh42, monkeypatch):
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, "2G")
+        budget, dev = kmem.min_chip_budget(mesh42)
+        assert budget == 2 * 2**30 and dev is None
+
+    def test_min_chip_budget_unknowable_chip_skips_admission(
+        self, mesh42, monkeypatch
+    ):
+        monkeypatch.delenv(kmem.HBM_BUDGET_ENV, raising=False)
+        devices = list(mesh42.devices.flat)
+        frees = {d.id: 10**9 for d in devices}
+        frees[devices[5].id] = None  # one chip cannot report
+        monkeypatch.setattr(
+            kmem, "hbm_budget", lambda device=None: frees[device.id]
+        )
+        assert kmem.min_chip_budget(mesh42) == (None, None)
+
+    def test_bcd_mesh_plan_within_2x_of_memory_analysis(self, rng, mesh42, monkeypatch):
+        """Acceptance bar: analytic per-chip bytes for the (data=4,
+        model=2) sharded BCD solve within 2x of the compiled SPMD module's
+        own ``memory_analysis()`` on the forced-8-device CPU backend."""
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, "1G")
+        a = rng.normal(size=(512, 256)).astype(np.float32)
+        b = rng.normal(size=(512, 8)).astype(np.float32)
+        est = BlockLeastSquaresEstimator(64, num_iter=2, lam=0.5, mesh=mesh42)
+        est.fit(a, b)
+        rep = est.last_fit_report
+        assert rep.chosen == "fused[mesh 4x2]"
+        plan = rep.plans["fused[mesh 4x2]"]
+        assert plan.analyzed and plan.mesh_axes == {"data": 4, "model": 2}
+        truth = plan.reported
+        analytic_static = plan.argument_bytes + plan.output_bytes
+        truth_static = truth["argument"] + truth["output"]
+        assert truth_static / 2 <= analytic_static <= truth_static * 2
+        # The charged temp never under-admits vs XLA's own number.
+        assert plan.temp_bytes >= truth["temp"]
+
+
+class TestMeshLadder:
+    """The mesh degradation ladder: full (data, model) mesh -> model-axis-
+    collapsed mesh -> single-device ladder, driven by a shrinking per-chip
+    ``KEYSTONE_HBM_BUDGET`` — with every tier producing identical
+    predictions (the acceptance bar)."""
+
+    # Tall-skinny: the row-sharded design matrix/residual dominate the
+    # per-chip footprint, so collapsing the model axis (data 4 -> 8)
+    # strictly shrinks each chip's share and the tier totals decrease
+    # monotonically down the ladder.
+    N, D, K = 2048, 256, 8
+
+    def _problem(self, rng):
+        a = rng.normal(size=(self.N, self.D)).astype(np.float32)
+        b = rng.normal(size=(self.N, self.K)).astype(np.float32)
+        return a, b
+
+    def _fit(self, a, b, mesh):
+        est = BlockLeastSquaresEstimator(64, num_iter=2, lam=0.5, mesh=mesh)
+        model = est.fit(a, b)
+        return est, np.asarray(model(jnp.asarray(a)))
+
+    def test_budget_walks_full_mesh_reduced_mesh_single_device(
+        self, rng, mesh42, monkeypatch
+    ):
+        a, b = self._problem(rng)
+
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, "1G")
+        est, p_full = self._fit(a, b, mesh42)
+        rep = est.last_fit_report
+        assert rep.chosen == "fused[mesh 4x2]"
+        assert rep.mesh_shape == {"data": 4, "model": 2}
+        assert list(rep.plans) == ["fused[mesh 4x2]"]  # lazy planning
+        t_full = rep.plans["fused[mesh 4x2]"].total_bytes
+
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, str(t_full - 1))
+        est, p_red = self._fit(a, b, mesh42)
+        rep = est.last_fit_report
+        assert rep.chosen == "fused[mesh 8x1]"
+        assert rep.mesh_shape == {"data": 8, "model": 1}
+        assert rep.denials == ["fused[mesh 4x2]"]
+        t_red = rep.plans["fused[mesh 8x1]"].total_bytes
+        assert t_red < t_full  # collapsing the model axis shrinks per-chip
+
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, str(t_red - 1))
+        est, p_single = self._fit(a, b, mesh42)
+        rep = est.last_fit_report
+        assert rep.chosen.startswith("single_device/")
+        assert rep.mesh_shape is None
+        assert rep.denials[:2] == ["fused[mesh 4x2]", "fused[mesh 8x1]"]
+
+        np.testing.assert_allclose(p_full, p_red, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(p_full, p_single, rtol=1e-5, atol=1e-5)
+
+    def test_runtime_oom_on_mesh_tier_steps_down(self, rng, mesh42, monkeypatch):
+        monkeypatch.delenv(kmem.HBM_BUDGET_ENV, raising=False)
+        a, b = self._problem(rng)
+        _, p_clean = self._fit(a, b, mesh42)
+        before = counters.get("solver_oom_retry")
+        with oom_faults(block_mod, "_execute_fused_bcd_mesh", failures=1):
+            est, p_retry = self._fit(a, b, mesh42)
+        rep = est.last_fit_report
+        assert rep.oom_retries == ["fused[mesh 4x2]"]
+        assert rep.chosen == "fused[mesh 8x1]"  # one tier down, not the floor
+        assert rep.mesh_shape == {"data": 8, "model": 1}
+        assert counters.get("solver_oom_retry") == before + 1
+        np.testing.assert_allclose(p_clean, p_retry, rtol=1e-5, atol=1e-5)
+
+    def test_bwls_mesh_ladder_steps_down(self, rng, mesh42, monkeypatch):
+        n, d, c = 512, 128, 8
+        cls = rng.integers(0, c, n)
+        x = (rng.normal(size=(n, d)) + 0.1 * cls[:, None]).astype(np.float32)
+        y = (2.0 * np.eye(c)[cls] - 1.0).astype(np.float32)
+
+        def fit():
+            est = BlockWeightedLeastSquaresEstimator(
+                32, num_iter=1, lam=0.1, mixture_weight=0.5, mesh=mesh42
+            )
+            model = est.fit(x, y)
+            return est, np.asarray(model(jnp.asarray(x)))
+
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, "1G")
+        est, p_full = fit()
+        rep = est.last_fit_report
+        assert rep.chosen == "fused[mesh 4x2]"
+        t_full = rep.plans["fused[mesh 4x2]"].total_bytes
+
+        monkeypatch.setenv(kmem.HBM_BUDGET_ENV, str(t_full - 1))
+        est, p_red = fit()
+        rep = est.last_fit_report
+        assert rep.chosen == "fused[mesh 8x1]"
+        assert rep.denials == ["fused[mesh 4x2]"]
+        assert rep.mesh_shape == {"data": 8, "model": 1}
+        np.testing.assert_allclose(p_full, p_red, rtol=2e-4, atol=2e-4)

@@ -270,3 +270,94 @@ class TestBlockedDesignContract:
         assert widths == (4, 2)
         assert x.shape == (10, 8)  # 2 blocks x bs=4, short block zero-padded
         np.testing.assert_array_equal(np.asarray(x[:, 6:8]), 0.0)
+
+
+class TestDeadline:
+    """The wall-clock watchdog: hangs become typed, counted, phase-named
+    ``DeadlineExceeded`` errors — never an indefinite stall."""
+
+    def test_hang_is_interrupted_and_typed(self):
+        import time
+
+        from keystone_tpu.core.resilience import DeadlineExceeded, deadline
+
+        before = counters.get("deadline_exceeded")
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded) as exc:
+            with deadline(0.2, phase="ingest"):
+                time.sleep(30.0)  # the hang — must NOT run to completion
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0  # interrupted mid-sleep, not post-hoc
+        assert exc.value.phase == "ingest"
+        assert exc.value.seconds == pytest.approx(0.2)
+        assert counters.get("deadline_exceeded") == before + 1
+
+    def test_fast_block_passes_untouched(self):
+        from keystone_tpu.core.resilience import deadline
+
+        before = counters.get("deadline_exceeded")
+        with deadline(30.0, phase="quick"):
+            out = 1 + 1
+        assert out == 2
+        assert counters.get("deadline_exceeded") == before
+
+    def test_nested_deadlines_restore_the_outer_timer(self):
+        import time
+
+        from keystone_tpu.core.resilience import DeadlineExceeded, deadline
+
+        with pytest.raises(DeadlineExceeded) as exc:
+            with deadline(0.4, phase="outer"):
+                with deadline(30.0, phase="inner"):
+                    pass  # inner finishes instantly; outer must survive
+                time.sleep(30.0)
+        assert exc.value.phase == "outer"
+
+    def test_loose_inner_deadline_cannot_suspend_a_tighter_outer(self):
+        """Arming a 600s inner deadline under a 0.3s outer one must NOT
+        park the outer bound for 600s — the tighter remaining budget
+        wins, attributed to the phase that was executing."""
+        import time
+
+        from keystone_tpu.core.resilience import DeadlineExceeded, deadline
+
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded) as exc:
+            with deadline(0.3, phase="outer"):
+                with deadline(600.0, phase="inner"):
+                    time.sleep(30.0)
+        assert time.monotonic() - t0 < 5.0
+        assert exc.value.phase == "inner"  # where execution was
+        assert exc.value.seconds == pytest.approx(0.3, abs=0.1)
+
+    def test_nonpositive_budget_rejected(self):
+        from keystone_tpu.core.resilience import deadline
+
+        with pytest.raises(ValueError, match="positive"):
+            with deadline(0.0):
+                pass
+
+    def test_off_main_thread_falls_back_to_posthoc(self):
+        """Signals cannot be armed off the main thread: the fallback still
+        converts an overrun into the typed error on exit."""
+        import threading
+
+        from keystone_tpu.core.resilience import DeadlineExceeded, deadline
+
+        result = {}
+
+        def work():
+            try:
+                with deadline(0.05, phase="bg"):
+                    import time
+
+                    time.sleep(0.2)
+                result["outcome"] = "no_error"
+            except DeadlineExceeded as e:
+                result["outcome"] = "typed"
+                result["phase"] = e.phase
+
+        t = threading.Thread(target=work)
+        t.start()
+        t.join(10.0)
+        assert result == {"outcome": "typed", "phase": "bg"}

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Seeded end-to-end chaos runner (CLI face of tests/chaos.py).
+
+Runs deterministic fault schedules — injected solver OOMs, transient tar
+IO, corrupt archive members, NaN-poisoned batches, mid-BCD preemption with
+``resume_from=`` restart, and watchdog-bounded hangs — against a real
+workload pipeline, and holds every run to the chaos invariant: complete
+with predictions equal to the fault-free run, or fail with a typed,
+counted, logged error.  Never a silent wrong model.
+
+Usage:
+    python tools/chaos_run.py --seed 3              # one schedule
+    python tools/chaos_run.py                       # the tier-1 seed set
+    python tools/chaos_run.py --full                # the full seed set
+    python tools/chaos_run.py --workload cifar      # RandomPatchCifar
+
+Exit status is nonzero if ANY schedule violates the invariant.  The first
+stdout line is the machine-readable JSON record (truncation-proof, same
+convention as bench.py); a short human summary follows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for p in (_ROOT, os.path.join(_ROOT, "tests")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("chaos_run")
+    p.add_argument("--seed", type=int, default=None, help="run ONE schedule")
+    p.add_argument(
+        "--full",
+        action="store_true",
+        help="run the full seed set instead of the tier-1 subset",
+    )
+    p.add_argument("--workload", default="mnist", choices=("mnist", "cifar"))
+    a = p.parse_args(argv)
+
+    import chaos
+
+    if a.seed is not None:
+        seeds = (a.seed,)
+    else:
+        seeds = chaos.FULL_SEEDS if a.full else chaos.TIER1_SEEDS
+
+    results = chaos.run_suite(seeds, workload=a.workload)
+    violations = [
+        r
+        for r in results
+        if not r.ok() or r.outcome != chaos.expected_outcome(r.fault)
+    ]
+    record = {
+        "metric": "chaos",
+        "workload": a.workload,
+        "seeds": list(seeds),
+        "ok": not violations,
+        "outcomes": {r.outcome: sum(1 for x in results if x.outcome == r.outcome) for r in results},
+        "results": [r.record() for r in results],
+    }
+    print(json.dumps(record), flush=True)
+    for r in results:
+        flag = "ok " if r.ok() and r.outcome == chaos.expected_outcome(r.fault) else "BAD"
+        print(
+            f"# {flag} seed={r.seed} {r.fault.kind}: {r.outcome}"
+            + (f" ({r.error_type})" if r.error_type else "")
+            + f" [{r.seconds:.2f}s]"
+        )
+    print(f"# chaos: {len(results) - len(violations)}/{len(results)} schedules honored the invariant")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
