@@ -1,0 +1,453 @@
+"""Streaming ingest: decode/featurize overlap with a host ring buffer and
+double-buffered H2D transfers.
+
+The reference hides decode latency behind per-executor parallelism
+(ImageLoaderUtils.scala decodes per executor while other executors
+featurize); the eager port decoded every tar member into host RAM before
+the first device batch ran, leaving the accelerator idle for the whole
+decode phase.  This module turns tar -> decode -> featurize into a
+bounded-capacity pipeline (the tf.data "prefetch to device" pattern):
+
+* **producer thread** — reads the tar serially (tar is a sequential
+  format; opens retry via ``core.resilience.retry``), decodes JPEGs on a
+  thread pool (``loaders.image_loaders.decode_threads()`` wide, with a
+  bounded in-order window of ``decode_threads() + decode_ahead()``
+  in-flight decodes), assembles decoded images into **shape buckets**
+  (XLA wants static shapes), and pushes batch-assembled ``np.ndarray``
+  chunks into a host **ring buffer**.  A full ring blocks the producer —
+  backpressure, so decode never runs unboundedly ahead of the device.
+* **transfer stage** — the consumer generator starts each chunk's H2D
+  (``jax.device_put``, dispatched asynchronously) as soon as it leaves the
+  ring and keeps **two** device-resident batches in flight: batch *i+1*
+  transfers while the consumer featurizes batch *i*.  The consumer
+  synchronizes (``np.asarray`` / ``block_until_ready``) only on the batch
+  it is consuming.
+* **consumer API** — ``stream_batches(path, batch_size, ...)`` yields
+  :class:`StreamBatch` in assembly order; each carries the global image
+  ordinals (``indices``) and member ``names`` so features scatter back to
+  decode-survival order exactly like the eager path.
+
+Resilience invariants preserved from the eager loaders:
+
+* tar opens retry transient IO (``io_retry`` counted); corrupt members
+  are counted skips (``corrupt_image``/``tar_member_error``) — never
+  silent, never fatal.
+* every ring wait is a short poll, so a ``resilience.deadline`` armed
+  around the consumer interrupts a hung decoder thread as a typed
+  ``DeadlineExceeded`` instead of deadlocking the pipeline.
+* consumer exceptions (or early exit) stop the producer and release the
+  decode pool; producer exceptions surface on the consumer's next
+  ``__next__``.  ``join()`` lets tests assert every thread exited.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Callable
+
+import jax
+import numpy as np
+
+from ..loaders import image_loaders
+from .resilience import counters
+
+_logger = logging.getLogger("keystone_tpu.ingest")
+
+#: Assembled chunks the host ring holds before the producer blocks.  Each
+#: slot is a decoded f32 batch (batch_size * H * W * 3 * 4 bytes), so the
+#: default bounds host RAM at ~4 batches beyond the decode window.
+DEFAULT_RING_CAPACITY = 4
+
+#: Device batches the transfer stage keeps in flight: the consumed batch
+#: plus the next one whose H2D overlaps the consumer's featurize.
+DEVICE_BUFFERS = 2
+
+#: Every blocking wait in the pipeline is a poll at this period so signals
+#: (the resilience.deadline SIGALRM) and stop flags are always observed.
+_POLL_SECONDS = 0.05
+
+
+def ring_capacity() -> int:
+    """Ring depth: ``KEYSTONE_RING_CAPACITY`` env or the default."""
+    raw = os.environ.get("KEYSTONE_RING_CAPACITY", "").strip()
+    if raw:
+        try:
+            val = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"KEYSTONE_RING_CAPACITY={raw!r} is not an integer"
+            ) from None
+        if val < 1:
+            raise ValueError(f"KEYSTONE_RING_CAPACITY={raw!r} must be >= 1")
+        return val
+    return DEFAULT_RING_CAPACITY
+
+
+class _Cancelled(Exception):
+    """Internal: the consumer stopped the stream — unwind the producer."""
+
+
+@dataclasses.dataclass
+class StreamBatch:
+    """One shape-bucketed, batch-assembled chunk of decoded images."""
+
+    index: int  #: chunk ordinal (FIFO yield order)
+    indices: np.ndarray  #: [b] global image ordinals in decode-survival order
+    names: list  #: [b] tar member names
+    host: np.ndarray  #: [b, H, W, C] f32 host batch
+    device: object | None = None  #: jax.Array once the transfer stage ran
+
+    @property
+    def shape(self) -> tuple:
+        """The bucket key: per-image (H, W)."""
+        return tuple(self.host.shape[1:3])
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def dev(self):
+        """The device-resident batch (transferring on demand when the
+        stream ran with ``transfer=False``)."""
+        if self.device is None:
+            self.device = jax.device_put(self.host)
+        return self.device
+
+
+@dataclasses.dataclass
+class StreamStats:
+    """Per-stream ingest counters (ring depth/stall accounting for the
+    bench ``e2e`` section and the backpressure tests)."""
+
+    decoded: int = 0  #: images decoded successfully
+    skipped: int = 0  #: corrupt members skipped (also counted globally)
+    batches: int = 0  #: chunks emitted into the ring
+    ring_capacity: int = 0
+    ring_max_depth: int = 0  #: high-water mark of assembled chunks queued
+    producer_stalls: int = 0  #: puts that blocked on a full ring (backpressure)
+    consumer_stalls: int = 0  #: gets that found the ring empty (decode-bound)
+
+    def record(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _Ring:
+    """Bounded FIFO between the producer thread and the consumer.
+
+    All waits poll at ``_POLL_SECONDS`` so the main thread stays
+    interruptible (resilience.deadline's SIGALRM) and the producer always
+    observes ``stop()``.  A producer error is stored and re-raised on the
+    consumer side; ``close()`` marks end-of-stream."""
+
+    _END = object()
+
+    def __init__(self, capacity: int, stats: StreamStats):
+        self._q: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._capacity = capacity
+        self._stats = stats
+        self._closed = False
+        self._stopped = False
+        self._error: BaseException | None = None
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def put(self, item) -> bool:
+        """Producer side; blocks while full (backpressure).  Returns False
+        when the consumer stopped the stream."""
+        with self._cond:
+            stalled = False
+            while len(self._q) >= self._capacity and not self._stopped:
+                if not stalled:
+                    self._stats.producer_stalls += 1
+                    stalled = True
+                self._cond.wait(_POLL_SECONDS)
+            if self._stopped:
+                return False
+            self._q.append(item)
+            self._stats.ring_max_depth = max(
+                self._stats.ring_max_depth, len(self._q)
+            )
+            self._cond.notify_all()
+            return True
+
+    def get(self):
+        """Consumer side; blocks while empty.  Returns ``_Ring._END`` at
+        end-of-stream, re-raises a producer failure."""
+        with self._cond:
+            stalled = False
+            while True:
+                if self._q:
+                    item = self._q.popleft()
+                    self._cond.notify_all()
+                    return item
+                if self._error is not None:
+                    err, self._error = self._error, None
+                    raise err
+                if self._closed or self._stopped:
+                    return self._END
+                if not stalled:
+                    self._stats.consumer_stalls += 1
+                    stalled = True
+                self._cond.wait(_POLL_SECONDS)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def fail(self, error: BaseException) -> None:
+        with self._cond:
+            self._error = error
+            self._closed = True
+            self._cond.notify_all()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+
+class IngestStream:
+    """The streaming pipeline: iterate to consume, ``with`` (or ``close``)
+    to guarantee shutdown, ``join()`` to assert no thread leaked."""
+
+    def __init__(
+        self,
+        path: str,
+        batch_size: int,
+        *,
+        keep: Callable[[str], bool] | None = None,
+        num_threads: int | None = None,
+        decode_ahead_slots: int | None = None,
+        capacity: int | None = None,
+        transfer: bool = True,
+    ):
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        self._path = path
+        self._batch_size = batch_size
+        self._keep = keep
+        self._num_threads = num_threads or image_loaders.decode_threads()
+        self._ahead = (
+            decode_ahead_slots
+            if decode_ahead_slots is not None
+            else image_loaders.decode_ahead()
+        )
+        self._transfer = transfer
+        self.stats = StreamStats(
+            ring_capacity=capacity if capacity is not None else ring_capacity()
+        )
+        self._ring = _Ring(self.stats.ring_capacity, self.stats)
+        self._workers: list[threading.Thread] = []
+        self._chunk_counter = 0
+        # One line per stream so operators can see the effective ingest
+        # configuration (the env knobs resolved) without env spelunking.
+        _logger.info(
+            "streaming ingest %s: batch=%d threads=%d ahead=%d ring=%d "
+            "transfer=%s",
+            path,
+            batch_size,
+            self._num_threads,
+            self._ahead,
+            self.stats.ring_capacity,
+            transfer,
+        )
+        self._iter = self._drain()
+        self._thread = threading.Thread(
+            target=self._produce, name="keystone-ingest-producer", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer side --------------------------------------------------------
+
+    def _register_worker(self):
+        self._workers.append(threading.current_thread())
+
+    def _await_decode(self, fut):
+        """Poll a decode future so a stopped stream abandons a hung decoder
+        instead of joining it forever."""
+        while True:
+            if self._ring.stopped:
+                raise _Cancelled()
+            try:
+                return fut.result(timeout=_POLL_SECONDS)
+            except _FutureTimeout:
+                continue
+
+    def _produce(self):
+        pool = ThreadPoolExecutor(
+            max_workers=self._num_threads,
+            thread_name_prefix="keystone-decode",
+            initializer=self._register_worker,
+        )
+        clean = False
+        try:
+            # Build/load the native decoder before the pool spins up (the
+            # one-time g++ build runs under native_decode's module lock and
+            # would otherwise stall every worker behind the first decode).
+            from ..loaders.native_decode import available as _native_available
+
+            _native_available()
+            # shape -> (ordinals, names, images); insertion-ordered so the
+            # end-of-stream flush of partial buckets is deterministic.
+            buckets: dict = {}
+            window: collections.deque = collections.deque()
+            ordinal = 0
+
+            def drain_one():
+                nonlocal ordinal
+                name, fut = window.popleft()
+                img = self._await_decode(fut)
+                if img is None:
+                    counters.record("corrupt_image", name)
+                    self.stats.skipped += 1
+                    return
+                self.stats.decoded += 1
+                key = img.shape[:2]
+                idx, names, imgs = buckets.setdefault(key, ([], [], []))
+                idx.append(ordinal)
+                names.append(name)
+                imgs.append(img)
+                ordinal += 1
+                if len(imgs) >= self._batch_size:
+                    self._emit(buckets.pop(key))
+
+            for name, data in image_loaders._iter_tar_members(self._path):
+                if self._ring.stopped:
+                    raise _Cancelled()
+                if self._keep is not None and not self._keep(name):
+                    continue
+                window.append(
+                    (name, pool.submit(image_loaders.decode_image, data))
+                )
+                if len(window) >= self._num_threads + self._ahead:
+                    drain_one()
+            while window:
+                drain_one()
+            # Flush the batch-size remainders (partial last batch per
+            # shape), oldest bucket first for a deterministic tail order.
+            for bucket in sorted(buckets.values(), key=lambda b: b[0][0]):
+                self._emit(bucket)
+            clean = True
+        except _Cancelled:
+            pass
+        except BaseException as e:  # noqa: BLE001 — surfaces on the consumer
+            self._ring.fail(e)
+        finally:
+            self._ring.close()
+            # A stopped stream may hold a hung decode future: abandon it
+            # (workers are daemon threads) instead of blocking shutdown.
+            pool.shutdown(wait=clean, cancel_futures=not clean)
+
+    def _emit(self, bucket):
+        idx, names, imgs = bucket
+        chunk = StreamBatch(
+            index=self._chunk_counter,
+            indices=np.asarray(idx, np.int64),
+            names=names,
+            host=np.stack(imgs),
+        )
+        self._chunk_counter += 1
+        if not self._ring.put(chunk):
+            raise _Cancelled()
+        self.stats.batches += 1
+
+    # -- consumer side --------------------------------------------------------
+
+    def _drain(self):
+        pending: collections.deque = collections.deque()
+        try:
+            while True:
+                item = self._ring.get()
+                if item is _Ring._END:
+                    break
+                if self._transfer:
+                    # Async dispatch: the H2D for this chunk starts now and
+                    # overlaps the consumer's work on the PREVIOUS chunk
+                    # still being featurized.
+                    item.device = jax.device_put(item.host)
+                pending.append(item)
+                if len(pending) >= DEVICE_BUFFERS:
+                    yield pending.popleft()
+            while pending:
+                yield pending.popleft()
+        finally:
+            self.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> StreamBatch:
+        return next(self._iter)
+
+    def __enter__(self) -> "IngestStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        """Stop the producer and release the ring.  Idempotent; called
+        automatically on stream exhaustion, consumer exception, or context
+        exit."""
+        self._ring.stop()
+
+    def join(self, timeout: float = 10.0) -> bool:
+        """Wait for the producer and every decoder thread to exit; returns
+        True when no ingest thread remains alive (the no-leak assertion the
+        tier-1 suite runs under pytest)."""
+        end = time.monotonic() + timeout
+        self._thread.join(max(0.0, end - time.monotonic()))
+        for t in list(self._workers):
+            t.join(max(0.0, end - time.monotonic()))
+        return not (
+            self._thread.is_alive()
+            or any(t.is_alive() for t in self._workers)
+        )
+
+
+def stream_batches(
+    path: str,
+    batch_size: int,
+    *,
+    keep: Callable[[str], bool] | None = None,
+    num_threads: int | None = None,
+    decode_ahead_slots: int | None = None,
+    capacity: int | None = None,
+    transfer: bool = True,
+) -> IngestStream:
+    """Stream shape-bucketed device batches from a tar (or directory of
+    tars) of images.
+
+    ``keep``: member-name predicate (label filtering before decode).
+    ``num_threads`` / ``decode_ahead_slots``: decoder sizing, defaulting to
+    the ``KEYSTONE_DECODE_THREADS`` / ``KEYSTONE_DECODE_AHEAD`` env knobs.
+    ``capacity``: ring depth (``KEYSTONE_RING_CAPACITY`` default).
+    ``transfer=False`` skips the H2D stage (host-only consumers, decode
+    benchmarking).
+
+    Yields :class:`StreamBatch` in assembly order; use as a context
+    manager (or iterate to exhaustion) so the decode threads are released,
+    and ``stream.join()`` to assert they exited."""
+    return IngestStream(
+        path,
+        batch_size,
+        keep=keep,
+        num_threads=num_threads,
+        decode_ahead_slots=decode_ahead_slots,
+        capacity=capacity,
+        transfer=transfer,
+    )

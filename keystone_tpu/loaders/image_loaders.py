@@ -30,7 +30,8 @@ from ..core.resilience import counters, retry
 # DECODED f32 images (~12x the JPEG bytes), so it must cover decode latency
 # without scaling multiplicatively with cores: threads + _DECODE_AHEAD total
 # in-flight entries keeps every core busy with a small constant of completed
-# results buffered behind a slow head-of-line decode.
+# results buffered behind a slow head-of-line decode.  Env-tunable via
+# ``KEYSTONE_DECODE_AHEAD`` (see :func:`decode_ahead`).
 _DECODE_AHEAD = 8
 
 VOC_NUM_CLASSES = 20  # constant of the VOC 2007 dataset
@@ -163,6 +164,24 @@ def decode_threads() -> int:
         return os.cpu_count() or 1
 
 
+def decode_ahead() -> int:
+    """Decode-ahead slots beyond the pool width: ``KEYSTONE_DECODE_AHEAD``
+    env or the :data:`_DECODE_AHEAD` default.  Total in-flight decodes per
+    stream = ``decode_threads() + decode_ahead()``."""
+    raw = os.environ.get("KEYSTONE_DECODE_AHEAD", "").strip()
+    if raw:
+        try:
+            val = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"KEYSTONE_DECODE_AHEAD={raw!r} is not an integer"
+            ) from None
+        if val < 0:
+            raise ValueError(f"KEYSTONE_DECODE_AHEAD={raw!r} must be >= 0")
+        return val
+    return _DECODE_AHEAD
+
+
 def _iter_tar_images(path: str, num_threads: int | None = None):
     """Yield (member_name, image) for each decodable image in the tar(s).
 
@@ -189,11 +208,12 @@ def _iter_tar_images(path: str, num_threads: int | None = None):
                 counters.record("corrupt_image", name)
         return
 
+    ahead = decode_ahead()
     with ThreadPoolExecutor(max_workers=num_threads) as pool:
         window: collections.deque = collections.deque()
         for name, data in _iter_tar_members(path):
             window.append((name, pool.submit(decode_image, data)))
-            if len(window) >= num_threads + _DECODE_AHEAD:
+            if len(window) >= num_threads + ahead:
                 done_name, fut = window.popleft()
                 img = fut.result()
                 if img is not None:
@@ -209,10 +229,10 @@ def _iter_tar_images(path: str, num_threads: int | None = None):
                 counters.record("corrupt_image", done_name)
 
 
-def voc_loader(data_path: str, labels_path: str, name_prefix: str = "VOCdevkit/VOC2007/JPEGImages/") -> MultiLabeledImages:
-    """VOC 2007 loader (reference VOCLoader.scala:42-64): labels CSV has
-    columns (id, class, classname, traintesteval, filename); class ids are
-    1-indexed in the file."""
+def voc_labels_map(labels_path: str) -> dict[str, list[int]]:
+    """Parse the VOC labels CSV (columns id, class, classname,
+    traintesteval, filename; class ids 1-indexed) into filename ->
+    class-id-list — shared by the eager loader and the streaming source."""
     labels_map: dict[str, list[int]] = {}
     with retry(open, name=f"open({labels_path})")(labels_path) as fh:
         next(fh, None)  # header (empty file -> no rows)
@@ -222,6 +242,26 @@ def voc_loader(data_path: str, labels_path: str, name_prefix: str = "VOCdevkit/V
             parts = line.strip().split(",")
             fname = parts[4].replace('"', "")
             labels_map.setdefault(fname, []).append(int(parts[1]) - 1)
+    return labels_map
+
+
+def imagenet_labels_map(labels_path: str) -> dict[str, int]:
+    """Parse the space-separated synset -> class-id labels file — shared by
+    the eager loader and the streaming source."""
+    labels_map: dict[str, int] = {}
+    with retry(open, name=f"open({labels_path})")(labels_path) as fh:
+        for line in fh:
+            parts = line.split()
+            if len(parts) >= 2:
+                labels_map[parts[0]] = int(parts[1])
+    return labels_map
+
+
+def voc_loader(data_path: str, labels_path: str, name_prefix: str = "VOCdevkit/VOC2007/JPEGImages/") -> MultiLabeledImages:
+    """VOC 2007 loader (reference VOCLoader.scala:42-64): labels CSV has
+    columns (id, class, classname, traintesteval, filename); class ids are
+    1-indexed in the file."""
+    labels_map = voc_labels_map(labels_path)
 
     images, labels, filenames = [], [], []
     for name, img in _iter_tar_images(data_path):
@@ -240,12 +280,7 @@ def imagenet_loader(data_path: str, labels_path: str) -> LabeledImages:
     """ImageNet loader (reference ImageNetLoader.scala:25-41): each tar holds
     one synset directory whose name maps to a class id via the
     space-separated labels file."""
-    labels_map: dict[str, int] = {}
-    with retry(open, name=f"open({labels_path})")(labels_path) as fh:
-        for line in fh:
-            parts = line.split()
-            if len(parts) >= 2:
-                labels_map[parts[0]] = int(parts[1])
+    labels_map = imagenet_labels_map(labels_path)
 
     images, labels, filenames = [], [], []
     for name, img in _iter_tar_images(data_path):

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.ingest import stream_batches
 from ..core.logging import Logging, configure_logging
 from ..core.memory import log_fit_report
 from ..core.pipeline import Pipeline
@@ -80,6 +81,11 @@ class RandomCifarConfig:
     #: ``BlockLeastSquaresEstimator.fit(checkpoint=, resume_from=)``.
     solve_checkpoint: object = None
     solve_resume: object = None
+    #: Streaming ingest (core.ingest): when set, TEST scoring streams this
+    #: JPEG tar — decode of chunk i+1 overlaps the conv featurize of chunk
+    #: i — instead of using the eagerly-loaded ``test`` batch.  Member
+    #: names carry the label as their leading directory ("<label>/x.jpg").
+    stream_test_tar: str | None = None
 
 
 class _Log(Logging):
@@ -183,6 +189,70 @@ def featurize_chunked(fn, images: np.ndarray, chunk: int, mesh=None) -> jnp.ndar
     return jnp.concatenate(outs, axis=0)
 
 
+def cifar_tar_label(name: str) -> int:
+    """Class id from a tar member's leading directory ("<label>/img.jpg" —
+    the synset-style layout the streaming CIFAR tar uses)."""
+    return int(name.split("/", 1)[0])
+
+
+def cifar_tar_loader(path: str) -> LabeledImageBatch:
+    """Eager CIFAR-from-JPEG-tar loader ("<label>/img.jpg" members, images
+    >= 36 px — the loaders' MIN_DIM floor rules out true-32px JPEGs):
+    threaded tar decode, labels parsed from member names.  The eager
+    counterpart of ``--streamTestTar``, and the train-side loader when a
+    CIFAR-style dataset ships as a JPEG tar (filter learning needs the
+    images resident)."""
+    from ..loaders.image_loaders import _iter_tar_images
+
+    pairs = list(_iter_tar_images(path))
+    if not pairs:
+        return LabeledImageBatch(
+            np.zeros((0, 1, 1, 3), np.float32), np.zeros(0, np.int32)
+        )
+    return LabeledImageBatch(
+        np.stack([img for _, img in pairs]),
+        np.asarray([cifar_tar_label(n) for n, _ in pairs], np.int32),
+    )
+
+
+def featurize_stream(fn, stream, chunk: int) -> tuple[np.ndarray, list]:
+    """Streaming counterpart of :func:`featurize_chunked`: consume
+    batch-assembled device chunks from ``core.ingest`` — the decode of
+    chunk *i+1* runs on host threads (and its H2D is already dispatched)
+    while the jitted featurizer runs chunk *i* — padding each chunk to the
+    compiled ``chunk`` rows.  The host sync lands only on the consumed
+    chunk's features.  Returns features scattered back to stream-ordinal
+    order plus the member names in that order."""
+    parts, name_pairs, n = [], [], 0
+    for batch in stream:
+        pad = chunk - batch.host.shape[0]
+        if pad > 0:
+            dev = jnp.asarray(
+                np.pad(batch.host, ((0, pad), (0, 0), (0, 0), (0, 0)))
+            )
+        elif pad < 0:
+            raise ValueError(
+                f"streamed batch of {batch.host.shape[0]} rows exceeds the "
+                f"compiled featurize chunk {chunk} — stream with "
+                "batch_size == featurize_chunk"
+            )
+        else:
+            dev = batch.dev()
+        feats = fn(dev)
+        parts.append((batch.indices, np.asarray(feats)[: len(batch)]))
+        name_pairs.extend(zip(batch.indices.tolist(), batch.names))
+        n += len(batch)
+    if not parts:
+        return np.zeros((0, 0), np.float32), []
+    out = np.zeros((n, parts[0][1].shape[1]), np.float32)
+    names = [None] * n
+    for idx, feats in parts:
+        out[idx] = feats
+    for i, name in name_pairs:
+        names[i] = name
+    return out, names
+
+
 def run(
     conf: RandomCifarConfig,
     train: LabeledImageBatch,
@@ -252,11 +322,27 @@ def run(
         train_pred, train.labels, conf.num_classes
     )
 
-    test_conv = featurize_chunked(
-        feat_fn, test.images, conf.featurize_chunk, mesh=mesh
-    )
-    test_pred = predict(scaler(test_conv))
-    test_eval = MulticlassClassifierEvaluator(test_pred, test.labels, conf.num_classes)
+    if conf.stream_test_tar is not None:
+        # Streaming ingest: JPEG decode of the next chunk overlaps the
+        # conv featurize of the current one (core.ingest ring buffer +
+        # double-buffered H2D); labels ride in the member names.
+        with stream_batches(
+            conf.stream_test_tar, conf.featurize_chunk
+        ) as st:
+            test_feats, names = featurize_stream(
+                feat_fn, st, conf.featurize_chunk
+            )
+        test_labels = np.asarray(
+            [cifar_tar_label(n) for n in names], np.int32
+        )
+        test_pred = predict(scaler(jnp.asarray(test_feats)))
+    else:
+        test_labels = test.labels
+        test_conv = featurize_chunked(
+            feat_fn, test.images, conf.featurize_chunk, mesh=mesh
+        )
+        test_pred = predict(scaler(test_conv))
+    test_eval = MulticlassClassifierEvaluator(test_pred, test_labels, conf.num_classes)
 
     secs = time.perf_counter() - t0
     results = {
@@ -278,7 +364,12 @@ def run(
 def main(argv=None):
     p = argparse.ArgumentParser("RandomPatchCifar")
     p.add_argument("--trainLocation", required=True)
-    p.add_argument("--testLocation", required=True)
+    p.add_argument(
+        "--testLocation",
+        default=None,
+        help="CIFAR binary (or JPEG tar); optional when --streamTestTar "
+        "supplies the test split",
+    )
     p.add_argument("--numFilters", type=int, default=100)
     p.add_argument("--patchSize", type=int, default=6)
     p.add_argument("--patchSteps", type=int, default=1)
@@ -288,6 +379,12 @@ def main(argv=None):
     p.add_argument("--lambda", dest="lam", type=float, default=None)
     p.add_argument("--sampleFrac", type=float, default=None)
     p.add_argument("--whitenerSize", type=int, default=100000)
+    p.add_argument(
+        "--streamTestTar",
+        default=None,
+        help="streaming ingest: score test from this JPEG tar "
+        "('<label>/name.jpg' members) with decode/featurize overlap",
+    )
     p.add_argument(
         "--mesh",
         default=None,
@@ -306,9 +403,28 @@ def main(argv=None):
         lam=a.lam,
         sample_frac=a.sampleFrac,
         whitener_size=a.whitenerSize,
+        stream_test_tar=a.streamTestTar,
     )
-    train = cifar_loader(conf.train_location)
-    test = cifar_loader(conf.test_location)
+    if a.testLocation is None and a.streamTestTar is None:
+        p.error("one of --testLocation / --streamTestTar is required")
+
+    def load_split(location):
+        # JPEG tars ("<label>/img.jpg" members) load through the threaded
+        # tar decoder; anything else is the CIFAR binary format.
+        if location.endswith((".tar", ".tar.gz", ".tgz")):
+            return cifar_tar_loader(location)
+        return cifar_loader(location)
+
+    train = load_split(conf.train_location)
+    if a.streamTestTar is not None:
+        # streamed test split: run() never touches the eager test batch —
+        # loading --testLocation too would decode a tar just to discard it
+        test = LabeledImageBatch(
+            np.zeros((0,) + train.images.shape[1:], np.float32),
+            np.zeros(0, np.int32),
+        )
+    else:
+        test = load_split(a.testLocation)
     return run(conf, train, test, mesh=parse_mesh(a.mesh))
 
 

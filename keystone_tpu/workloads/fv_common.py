@@ -104,3 +104,78 @@ def scatter_features(buckets: dict, transform, n_total: int, feature_dim: int) -
         # true images scatter back
         out[np.asarray(idx)] = np.asarray(transform(descs))[: len(idx)]
     return out
+
+
+# -- streaming ingest (core.ingest) -------------------------------------------
+
+
+def _ordered_names(pairs: list, n: int) -> list:
+    names = [None] * n
+    for i, name in pairs:
+        names[i] = name
+    return names
+
+
+def stream_descriptor_buckets(stream, per_batch) -> tuple[dict, list]:
+    """Build the ``bucket_by_shape``-shaped descriptor dict by consuming a
+    ``core.ingest`` stream: ``per_batch`` ([b, H, W, C] device batch ->
+    per-image descriptor array) runs on chunk *i* while chunk *i+1* decodes
+    on the host and transfers (the decode/featurize overlap the eager path
+    lacks — it decoded the whole tar before the first device batch).
+
+    Per-batch results stay on device (async dispatch — no sync until a
+    downstream consumer pulls), and are concatenated per shape at
+    end-of-stream, so ``{shape: (idx, descs)}`` is element-identical to the
+    eager ``bucket_by_shape`` + per-bucket featurize.  Returns the buckets
+    plus member names in stream-ordinal order (the loaders' filename
+    order)."""
+    parts: dict = {}
+    name_pairs: list = []
+    n = 0
+    for batch in stream:
+        descs = per_batch(batch.dev())
+        parts.setdefault(batch.shape, []).append((batch.indices, descs))
+        name_pairs.extend(zip(batch.indices.tolist(), batch.names))
+        n += len(batch)
+    buckets = {}
+    # Insertion order = each shape's FIRST image ordinal, matching eager
+    # bucket_by_shape's first-occurrence order exactly: downstream seeded
+    # column sampling (sample_columns) iterates the dict sequentially from
+    # one rng, so a chunk-emission order (first FULL batch first) would
+    # silently pick different PCA/GMM samples than the eager path.
+    for shape, chunks in sorted(
+        parts.items(), key=lambda kv: kv[1][0][0][0]
+    ):
+        idx = np.concatenate([c[0] for c in chunks])
+        descs = (
+            chunks[0][1]
+            if len(chunks) == 1
+            else jnp.concatenate([c[1] for c in chunks], axis=0)
+        )
+        buckets[shape] = (idx, descs)
+    return buckets, _ordered_names(name_pairs, n)
+
+
+def scatter_features_streaming(stream, transform, feature_dim: int) -> tuple[np.ndarray, list]:
+    """Streaming variant of :func:`scatter_features`: consume shape-bucketed
+    device batches from ``core.ingest``, apply ``transform`` ([b, H, W, C]
+    device batch -> [b, D] features) per batch, and scatter rows back to
+    stream-ordinal (decode-survival) order.
+
+    The host sync (``np.asarray``) lands only on the CONSUMED batch —
+    decode threads keep filling the ring and the next batch's H2D is
+    already in flight while this batch's features are pulled.  Returns
+    ``(features [n, D] f32, names)``."""
+    parts: list = []
+    name_pairs: list = []
+    n = 0
+    for batch in stream:
+        feats = transform(batch.dev())
+        # sync on the consumed batch only; later batches decode/transfer on
+        parts.append((batch.indices, np.asarray(feats, np.float32)))
+        name_pairs.extend(zip(batch.indices.tolist(), batch.names))
+        n += len(batch)
+    out = np.zeros((n, feature_dim), np.float32)
+    for idx, feats in parts:
+        out[idx] = feats[: len(idx)]
+    return out, _ordered_names(name_pairs, n)

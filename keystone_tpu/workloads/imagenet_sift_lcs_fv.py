@@ -20,10 +20,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
+from ..core.ingest import stream_batches
 from ..core.logging import Logging, configure_logging
 from ..core.memory import log_fit_report
 from ..core.resilience import assert_all_finite
-from ..loaders.image_loaders import LabeledImages, imagenet_loader
+from ..loaders.image_loaders import (
+    LabeledImages,
+    imagenet_labels_map,
+    imagenet_loader,
+)
 from ..ops.lcs import LCSExtractor
 from ..ops.sift import SIFTExtractor
 from ..ops.stats import SignedHellingerMapper
@@ -40,10 +45,80 @@ from .fv_common import (
     sample_columns,
     scatter_features,
     shard_batch,
+    stream_descriptor_buckets,
 )
 
 # Hard cap on the GMM EM training set (reference ImageNetSiftLcsFV.scala:85-86).
 GMM_FIT_CAP = 1_000_000
+
+
+@dataclass
+class ImageNetStreamSource:
+    """Streaming stand-in for :class:`LabeledImages` (core.ingest): each
+    descriptor branch streams the tar — decode of batch *i+1* overlaps the
+    device featurize of batch *i* — instead of decoding everything into
+    host RAM first.  Both branches must observe the SAME survivor order
+    (features are concatenated row-wise), which :meth:`record_names`
+    asserts across passes."""
+
+    data_path: str
+    labels_path: str
+    batch_size: int = 32
+
+    def __post_init__(self):
+        self._names: list | None = None
+        self._labels_map: dict | None = None
+
+    @property
+    def images(self) -> "ImageNetStreamSource":
+        return self
+
+    def labels_map(self) -> dict:
+        if self._labels_map is None:
+            self._labels_map = imagenet_labels_map(self.labels_path)
+        return self._labels_map
+
+    def record_names(self, names: list) -> None:
+        if self._names is None:
+            self._names = names
+        elif self._names != names:
+            raise RuntimeError(
+                "streaming ingest order drifted between descriptor passes "
+                f"({len(self._names)} vs {len(names)} survivors) — the "
+                "SIFT and LCS branches would zip features of different "
+                "images"
+            )
+
+    @property
+    def labels(self) -> np.ndarray:
+        if self._names is None:
+            raise RuntimeError(
+                "ImageNetStreamSource.labels before the descriptor pass"
+            )
+        lm = self.labels_map()
+        return np.asarray(
+            [lm[n.split("/")[0]] for n in self._names], np.int32
+        )
+
+    def __len__(self) -> int:
+        if self._names is None:
+            raise RuntimeError(
+                "len(ImageNetStreamSource) before the descriptor pass"
+            )
+        return len(self._names)
+
+
+def _streaming_buckets(src: ImageNetStreamSource, per_batch) -> dict:
+    """One branch's descriptor pass over the stream (synset-filtered)."""
+    lm = src.labels_map()
+
+    def keep(name: str) -> bool:
+        return name.split("/")[0] in lm
+
+    with stream_batches(src.data_path, src.batch_size, keep=keep) as st:
+        buckets, names = stream_descriptor_buckets(st, per_batch)
+    src.record_names(names)
+    return buckets
 
 
 @dataclass
@@ -131,6 +206,10 @@ def sift_descriptor_buckets(
         scale_step=conf.sift_scale_step, compute_dtype=jnp.bfloat16
     )
     hell = SignedHellingerMapper()
+    if isinstance(images, ImageNetStreamSource):
+        return _streaming_buckets(
+            images, lambda dev: hell(sift(grayscale(dev)))
+        )
     buckets = {}
     for shape, (idx, batch) in bucket_by_shape(images).items():
         gray = grayscale(shard_batch(batch, mesh))
@@ -143,6 +222,8 @@ def lcs_descriptor_buckets(
 ) -> dict:
     """LCS branch descriptors (:96-148): raw LCS straight into PCA."""
     lcs = LCSExtractor(conf.lcs_stride, conf.lcs_border, conf.lcs_patch)
+    if isinstance(images, ImageNetStreamSource):
+        return _streaming_buckets(images, lcs)
     return {
         shape: (idx, lcs(shard_batch(batch, mesh)))
         for shape, (idx, batch) in bucket_by_shape(images).items()
@@ -315,6 +396,18 @@ def main(argv=None):
         "PCA+GMM and the weighted solve",
     )
     p.add_argument(
+        "--streamIngest",
+        action="store_true",
+        help="streaming ingest (core.ingest): decode tars WHILE the device "
+        "featurizes, instead of decoding everything first",
+    )
+    p.add_argument(
+        "--streamBatchSize",
+        type=int,
+        default=32,
+        help="images per streamed device batch (--streamIngest only)",
+    )
+    p.add_argument(
         "--mesh",
         default=None,
         help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
@@ -354,9 +447,18 @@ def main(argv=None):
         # Restored runs never touch training data — skip decoding the
         # entire training tar set (the dominant reload-path cost).
         train = LabeledImages([], np.zeros(0, np.int32), [])
+    elif a.streamIngest:
+        train = ImageNetStreamSource(
+            conf.train_location, conf.label_path, batch_size=a.streamBatchSize
+        )
     else:
         train = imagenet_loader(conf.train_location, conf.label_path)
-    test = imagenet_loader(conf.test_location, conf.label_path)
+    if a.streamIngest:
+        test = ImageNetStreamSource(
+            conf.test_location, conf.label_path, batch_size=a.streamBatchSize
+        )
+    else:
+        test = imagenet_loader(conf.test_location, conf.label_path)
     return run(conf, train, test, mesh=parse_mesh(a.mesh))
 
 

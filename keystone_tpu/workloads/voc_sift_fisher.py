@@ -20,11 +20,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
+from ..core.ingest import stream_batches
 from ..core.logging import Logging, configure_logging
 from ..core.memory import log_fit_report
 from ..core.resilience import assert_all_finite
 from ..evaluation.map import MeanAveragePrecisionEvaluator
-from ..loaders.image_loaders import VOC_NUM_CLASSES, MultiLabeledImages, voc_loader
+from ..loaders.image_loaders import (
+    VOC_NUM_CLASSES,
+    MultiLabeledImages,
+    voc_labels_map,
+    voc_loader,
+)
 from ..ops.sift import SIFTExtractor
 from ..ops.util import ClassLabelIndicatorsFromIntArrayLabels
 from ..parallel.mesh import parse_mesh
@@ -38,7 +44,57 @@ from .fv_common import (
     sample_columns,
     scatter_features,
     shard_batch,
+    stream_descriptor_buckets,
 )
+
+
+@dataclass
+class VOCStreamSource:
+    """Streaming stand-in for :class:`MultiLabeledImages` (core.ingest):
+    images are decoded from the tar WHILE the device featurizes — SIFT on
+    batch *i* overlaps decode of batch *i+1* — instead of the eager
+    decode-everything-first path.  ``labels``/``len`` become available
+    after the descriptor pass records the decode-survival order."""
+
+    data_path: str
+    labels_path: str
+    name_prefix: str = "VOCdevkit/VOC2007/JPEGImages/"
+    batch_size: int = 64
+
+    def __post_init__(self):
+        self._names: list | None = None
+        self._labels_map: dict | None = None
+
+    @property
+    def images(self) -> "VOCStreamSource":
+        # The workload passes ``data.images`` into the descriptor
+        # extractors; for a stream source the "images" ARE the source.
+        return self
+
+    def labels_map(self) -> dict:
+        if self._labels_map is None:
+            self._labels_map = voc_labels_map(self.labels_path)
+        return self._labels_map
+
+    def record_names(self, names: list) -> None:
+        self._names = names
+
+    @property
+    def labels(self) -> list:
+        if self._names is None:
+            raise RuntimeError(
+                "VOCStreamSource.labels before the descriptor pass — the "
+                "streaming extract must run first (it records image order)"
+            )
+        lm = self.labels_map()
+        return [lm[n] for n in self._names]
+
+    def __len__(self) -> int:
+        if self._names is None:
+            raise RuntimeError(
+                "len(VOCStreamSource) before the descriptor pass"
+            )
+        return len(self._names)
 
 
 @dataclass
@@ -87,6 +143,22 @@ def extract_sift_buckets(
         scale_step=conf.scale_step,
         compute_dtype=jnp.bfloat16,
     )
+    if isinstance(images, VOCStreamSource):
+        # Streaming ingest: decode of batch i+1 overlaps SIFT of batch i
+        # (core.ingest ring buffer + double-buffered H2D).  Label-less and
+        # non-JPEGImages members are filtered before decode.
+        src = images
+        lm = src.labels_map()
+
+        def keep(name: str) -> bool:
+            return name.startswith(src.name_prefix) and name in lm
+
+        with stream_batches(src.data_path, src.batch_size, keep=keep) as st:
+            buckets, names = stream_descriptor_buckets(
+                st, lambda dev: sift(grayscale(dev))
+            )
+        src.record_names(names)
+        return buckets
     out = {}
     for shape, (idx, batch) in bucket_by_shape(images).items():
         gray = grayscale(shard_batch(batch, mesh))
@@ -119,11 +191,13 @@ def run(
         batch_pca, gmm, model = ck["pca"], ck["gmm"], ck["model"]
         fisher = fisher_feature_pipeline(gmm)
     else:
+        # Part 1+2: SIFT descriptors per shape bucket (reference :36-57).
+        # Runs BEFORE the label node: a streaming source only knows its
+        # image order (and therefore labels) after the descriptor pass.
+        train_desc = extract_sift_buckets(conf, train.images, mesh)
+
         label_node = ClassLabelIndicatorsFromIntArrayLabels(VOC_NUM_CLASSES)
         train_labels = label_node(train.labels)
-
-        # Part 1+2: SIFT descriptors per shape bucket (reference :36-57)
-        train_desc = extract_sift_buckets(conf, train.images, mesh)
 
         # Part 1a: PCA — fit on sampled descriptor columns, or load (:40-50)
         if conf.pca_file is not None:
@@ -230,6 +304,18 @@ def main(argv=None):
         help="resumable BCD state path: per-block checkpoint + auto-resume",
     )
     p.add_argument(
+        "--streamIngest",
+        action="store_true",
+        help="streaming ingest (core.ingest): decode the tar WHILE the "
+        "device runs SIFT, instead of decoding everything first",
+    )
+    p.add_argument(
+        "--streamBatchSize",
+        type=int,
+        default=64,
+        help="images per streamed device batch (--streamIngest only)",
+    )
+    p.add_argument(
         "--mesh",
         default=None,
         help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
@@ -256,9 +342,18 @@ def main(argv=None):
         # Restored runs never touch training data — skip decoding the
         # entire training tar (the dominant reload-path cost).
         train = MultiLabeledImages([], [], [])
+    elif a.streamIngest:
+        train = VOCStreamSource(
+            conf.train_location, conf.label_path, batch_size=a.streamBatchSize
+        )
     else:
         train = voc_loader(conf.train_location, conf.label_path)
-    test = voc_loader(conf.test_location, conf.label_path)
+    if a.streamIngest:
+        test = VOCStreamSource(
+            conf.test_location, conf.label_path, batch_size=a.streamBatchSize
+        )
+    else:
+        test = voc_loader(conf.test_location, conf.label_path)
     return run(conf, train, test, mesh=parse_mesh(a.mesh))
 
 

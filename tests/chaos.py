@@ -30,6 +30,13 @@ draws its parameters — fully deterministic):
 * ``deadline`` — an injected hang in the solve, bounded by
   ``resilience.deadline``: the run must die with a typed
   ``DeadlineExceeded`` naming the phase (counted ``deadline_exceeded``).
+* ``stream_corrupt`` — a corrupt member MID-STREAM on the streaming
+  ingest path (core.ingest): the stream must skip-and-count it and the
+  streamed features must equal a fault-free stream over the surviving
+  images bit-for-bit.
+* ``stream_hang`` — an injected decoder-thread hang under the streaming
+  path, bounded by ``resilience.deadline``: typed ``DeadlineExceeded``,
+  never a deadlocked ring.
 """
 
 from __future__ import annotations
@@ -38,6 +45,7 @@ import contextlib
 import dataclasses
 import os
 import shutil
+import tarfile
 import tempfile
 import time
 
@@ -46,6 +54,7 @@ import numpy as np
 import faults
 
 from keystone_tpu.core import checkpoint as ckpt_mod
+from keystone_tpu.core import ingest
 from keystone_tpu.core import memory as kmem
 from keystone_tpu.core.resilience import (
     DeadlineExceeded,
@@ -75,6 +84,8 @@ FAMILIES = (
     "nan_input",
     "preempt_resume",
     "deadline",
+    "stream_corrupt",
+    "stream_hang",
 )
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
@@ -84,6 +95,7 @@ FULL_SEEDS = tuple(range(21))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
+_N_STREAM_IMAGES = 10  # streaming-path tars (corrupt picked mid-stream)
 
 
 class SimulatedPreemption(RuntimeError):
@@ -158,6 +170,22 @@ def make_schedule(seed: int) -> Fault:
         return Fault(kind, {"frac": float(rng.uniform(0.002, 0.02))})
     if kind == "preempt_resume":
         return Fault(kind, {"preempt_after_blocks": 1})
+    if kind == "stream_corrupt":
+        k = int(rng.integers(1, 3))
+        corrupt = tuple(  # strictly mid-stream members
+            sorted(
+                int(i)
+                for i in rng.choice(
+                    np.arange(1, _N_STREAM_IMAGES - 1), k, replace=False
+                )
+            )
+        )
+        return Fault(kind, {"corrupt": corrupt, "batch": 4})
+    if kind == "stream_hang":
+        return Fault(
+            kind,
+            {"hang_at": int(rng.integers(1, 6)), "seconds": 0.8},
+        )
     return Fault("deadline", {"seconds": 1.0})
 
 
@@ -356,6 +384,107 @@ def _ingest_phase(fault: Fault, tmpdir: str, seed: int) -> None:
         )
 
 
+def _stream_featurize(tar_path: str, batch: int):
+    """The streaming-path probe pipeline: core.ingest stream -> per-image
+    device featurize -> scatter back to stream order (the real consumer
+    API, fv_common.scatter_features_streaming)."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.workloads.fv_common import scatter_features_streaming
+
+    feat = jax.jit(
+        lambda x: jnp.stack(
+            [jnp.mean(x, axis=(1, 2, 3)), jnp.max(x, axis=(1, 2, 3))], axis=1
+        )
+    )
+    with ingest.stream_batches(tar_path, batch) as st:
+        feats, names = scatter_features_streaming(st, feat, 2)
+    if not st.join(10.0):
+        raise ChaosOracleError(
+            "streaming ingest left decoder/producer threads alive"
+        )
+    return feats, names
+
+
+def _stream_corrupt_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """Corrupt member mid-stream: the streaming path must count the skip
+    and produce features BIT-IDENTICAL to a fault-free stream over the
+    surviving images (tar rebuilt from the same member bytes)."""
+    rng = np.random.default_rng(seed)
+    corrupt = tuple(fault.params["corrupt"])
+    batch = int(fault.params["batch"])
+    tar_bad = os.path.join(tmpdir, f"chaos_stream_{seed}.tar")
+    names = faults.make_image_tar(
+        tar_bad, _N_STREAM_IMAGES, rng, corrupt=corrupt
+    )
+    survivors = {n for i, n in enumerate(names) if i not in corrupt}
+    # The fault-free oracle tar: the SAME member bytes minus the corrupt
+    # ones, so decoded survivors are identical by construction.
+    tar_ok = os.path.join(tmpdir, f"chaos_stream_{seed}_ok.tar")
+    with tarfile.open(tar_bad) as src, tarfile.open(tar_ok, "w") as dst:
+        for m in src:
+            if m.name in survivors:
+                dst.addfile(m, src.extractfile(m))
+
+    before = counters.get("corrupt_image")
+    faulted_feats, faulted_names = _stream_featurize(tar_bad, batch)
+    skipped = counters.get("corrupt_image") - before
+    if skipped != len(corrupt):
+        raise ChaosOracleError(
+            f"{len(corrupt)} corrupt member(s) but {skipped} counted skips "
+            "on the streaming path — a corrupt member was swallowed "
+            "uncounted"
+        )
+    clean_feats, clean_names = _stream_featurize(tar_ok, batch)
+    if faulted_names != clean_names:
+        raise ChaosOracleError(
+            f"streaming ingest lost data: {faulted_names} != {clean_names}"
+        )
+    if not np.array_equal(faulted_feats, clean_feats):
+        raise ChaosOracleError(
+            "streamed features under a corrupt member differ from the "
+            "fault-free stream on the surviving images"
+        )
+
+
+def _stream_hang_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """Injected decoder-thread hang: the consumer's resilience.deadline
+    must convert it into a typed DeadlineExceeded — the ring must never
+    deadlock.  Raises (the schedule's expected outcome is typed_error)."""
+    rng = np.random.default_rng(seed)
+    tar_path = os.path.join(tmpdir, f"chaos_hang_{seed}.tar")
+    faults.make_image_tar(tar_path, _N_STREAM_IMAGES, rng)
+    budget = float(fault.params["seconds"])
+    hang_at = int(fault.params["hang_at"])
+    calls = {"n": 0}
+    real = image_loaders.decode_image
+
+    def hanging(data):
+        calls["n"] += 1
+        if calls["n"] == hang_at:
+            time.sleep(4.0 * budget)  # outlives the watchdog budget
+        return real(data)
+
+    # The patch must be live BEFORE the stream constructs: the producer
+    # thread starts submitting decode_image calls immediately, and a
+    # late patch could race past the hang_at'th decode entirely.
+    st = None
+    try:
+        with _patched(image_loaders, "decode_image", hanging):
+            st = ingest.stream_batches(tar_path, 4, num_threads=2)
+            with deadline(budget, phase="ingest"):
+                for batch in st:
+                    np.asarray(batch.host)
+    finally:
+        if st is not None:
+            st.close()
+    raise ChaosOracleError(
+        "hung decoder thread did not trip the ingest deadline — the "
+        "stream completed (or deadlocked silently)"
+    )
+
+
 def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
     """Apply one schedule to the workload; returns the results dict (or
     raises).  Each branch is the minimal faithful injection for its
@@ -376,6 +505,13 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
     if fault.kind in ("io_transient", "corrupt_members"):
         _ingest_phase(fault, tmpdir, seed)
         return _run_workload(workload)
+
+    if fault.kind == "stream_corrupt":
+        _stream_corrupt_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "stream_hang":
+        return _stream_hang_phase(fault, tmpdir, seed)  # always raises
 
     if fault.kind == "nan_input":
         frac = fault.params["frac"]
@@ -443,7 +579,7 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
 def expected_outcome(fault: Fault) -> str:
     """What a HEALTHY system does under this schedule."""
-    if fault.kind in ("nan_input", "deadline"):
+    if fault.kind in ("nan_input", "deadline", "stream_hang"):
         return "typed_error"
     return "completed_equal"
 

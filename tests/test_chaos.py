@@ -23,6 +23,8 @@ EXPECTED_COUNTER = {
     "nan_input": "nonfinite_model",
     "preempt_resume": "chaos_preemption",
     "deadline": "deadline_exceeded",
+    "stream_corrupt": "corrupt_image",
+    "stream_hang": "deadline_exceeded",
 }
 
 
@@ -54,6 +56,8 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     kinds = {chaos.make_schedule(s).kind for s in chaos.TIER1_SEEDS}
     assert kinds == set(chaos.FAMILIES)
     assert {"preempt_resume", "deadline"} <= kinds
+    # Streaming-ingest coverage (ISSUE 4): >= 2 streaming schedules in tier-1
+    assert {"stream_corrupt", "stream_hang"} <= kinds
 
 
 def test_schedules_are_deterministic():

@@ -1,0 +1,303 @@
+"""Streaming-ingest tests (core.ingest): ring-buffer semantics — ordering,
+backpressure, clean shutdown (no leaked threads under pytest), empty tar,
+batch-size remainder — and eager-vs-streaming feature equality.
+
+The decode path is the REAL one (JPEG tars built by tests/faults.py, the
+native/PIL decoder), so these tests also hold the streaming pipeline to the
+eager loaders' resilience contract: corrupt members are counted skips,
+producer failures surface typed on the consumer, and a hung decoder is
+interruptible by ``resilience.deadline`` instead of deadlocking the ring.
+"""
+
+import io
+import tarfile
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import faults
+
+from keystone_tpu.core import ingest
+from keystone_tpu.core.resilience import DeadlineExceeded, counters, deadline
+from keystone_tpu.loaders import image_loaders
+from keystone_tpu.workloads.fv_common import (
+    bucket_by_shape,
+    scatter_features_streaming,
+    stream_descriptor_buckets,
+)
+
+
+def _make_tar(path, sizes, rng, corrupt=()):
+    """Tar of JPEGs with per-member (h, w) ``sizes`` (mixed shapes bucket
+    into separate chunks).  Returns member names."""
+    names = []
+    with tarfile.open(path, "w") as tf:
+        for i, (h, w) in enumerate(sizes):
+            data = faults.make_jpeg_bytes(rng, h, w)
+            if i in corrupt:
+                data = faults.corrupt_jpeg(data, rng)
+            info = tarfile.TarInfo(f"img_{i:04d}.jpg")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+            names.append(info.name)
+    return names
+
+
+@pytest.fixture
+def tar_uniform(tmp_path, rng):
+    """10 same-shape JPEGs — batch 4 yields 4+4+2 (remainder)."""
+    path = str(tmp_path / "uniform.tar")
+    names = _make_tar(path, [(48, 48)] * 10, rng)
+    return path, names
+
+
+@pytest.fixture
+def tar_mixed(tmp_path, rng):
+    """12 JPEGs in two shapes, interleaved — exercises shape bucketing."""
+    sizes = [(48, 48), (64, 40)] * 6
+    path = str(tmp_path / "mixed.tar")
+    names = _make_tar(path, sizes, rng)
+    return path, names
+
+
+def _eager(path):
+    """The eager loader's (name, image) order — the streaming oracle."""
+    return list(image_loaders._iter_tar_images(path, num_threads=1))
+
+
+def test_stream_yields_every_image_in_order(tar_uniform):
+    path, _ = tar_uniform
+    eager = _eager(path)
+    got = {}
+    with ingest.stream_batches(path, 4, transfer=False) as st:
+        for batch in st:
+            assert batch.host.shape[0] == len(batch.names) == len(batch.indices)
+            for i, name, img in zip(
+                batch.indices.tolist(), batch.names, batch.host
+            ):
+                got[i] = (name, img)
+    assert st.join(10.0)
+    assert sorted(got) == list(range(len(eager)))
+    for i, (name, img) in enumerate(eager):
+        assert got[i][0] == name
+        np.testing.assert_array_equal(got[i][1], img)
+
+
+def test_batch_size_remainder(tar_uniform):
+    path, _ = tar_uniform
+    with ingest.stream_batches(path, 4, transfer=False) as st:
+        sizes = [len(b) for b in st]
+    assert sizes == [4, 4, 2]
+    assert st.stats.decoded == 10 and st.stats.batches == 3
+
+
+def test_mixed_shapes_bucket_and_preserve_ordinals(tar_mixed):
+    path, _ = tar_mixed
+    eager = _eager(path)
+    with ingest.stream_batches(path, 3, transfer=False) as st:
+        batches = list(st)
+    # every chunk is single-shape
+    for b in batches:
+        assert b.host.shape[1:3] == b.shape
+        assert len({img.shape for img in b.host}) == 1
+    # ordinals cover the stream exactly once, in decode-survival order
+    all_idx = np.concatenate([b.indices for b in batches])
+    assert sorted(all_idx.tolist()) == list(range(len(eager)))
+    name_of = {
+        i: n
+        for b in batches
+        for i, n in zip(b.indices.tolist(), b.names)
+    }
+    assert [name_of[i] for i in range(len(eager))] == [n for n, _ in eager]
+
+
+def test_empty_tar(tmp_path, rng):
+    path = str(tmp_path / "empty.tar")
+    _make_tar(path, [], rng)
+    with ingest.stream_batches(path, 4, transfer=False) as st:
+        assert list(st) == []
+    assert st.join(10.0)
+    assert st.stats.decoded == 0 and st.stats.batches == 0
+
+
+def test_backpressure_producer_blocks_at_capacity(tar_uniform):
+    path, _ = tar_uniform
+    st = ingest.stream_batches(
+        path, 2, capacity=1, num_threads=2, transfer=False
+    )
+    with st:
+        first = next(iter(st))
+        assert len(first) == 2
+        # A full ring must stall the producer rather than let decode run
+        # unboundedly ahead; give it time to fill the single slot and block.
+        deadline_t = time.monotonic() + 5.0
+        while (
+            st.stats.producer_stalls == 0 and time.monotonic() < deadline_t
+        ):
+            time.sleep(0.02)
+        assert st.stats.producer_stalls >= 1
+        assert st.stats.ring_max_depth <= 1
+        rest = list(st)
+    assert st.join(10.0)
+    assert sum(len(b) for b in rest) == 10 - 2
+
+
+def test_early_consumer_exit_joins_all_threads(tar_uniform):
+    path, _ = tar_uniform
+    before = {t.name for t in threading.enumerate()}
+    st = ingest.stream_batches(path, 2, capacity=1, transfer=False)
+    for batch in st:
+        break  # consumer bails after ONE batch (e.g. an exception upstream)
+    st.close()
+    assert st.join(10.0), "decoder/producer threads leaked past close()"
+    leaked = {
+        t.name
+        for t in threading.enumerate()
+        if t.name.startswith(("keystone-ingest", "keystone-decode"))
+    } - before
+    assert not leaked, leaked
+
+
+def test_exhausted_stream_joins_all_threads(tar_uniform):
+    path, _ = tar_uniform
+    with ingest.stream_batches(path, 4, transfer=False) as st:
+        list(st)
+    assert st.join(10.0)
+
+
+def test_producer_error_surfaces_on_consumer(tmp_path):
+    st = ingest.stream_batches(str(tmp_path / "nope.tar"), 4, transfer=False)
+    with pytest.raises(FileNotFoundError):
+        next(iter(st))
+    assert st.join(10.0)
+
+
+def test_corrupt_member_is_counted_skip(tmp_path, rng):
+    path = str(tmp_path / "corrupt.tar")
+    names = _make_tar(path, [(48, 48)] * 6, rng, corrupt=(2, 4))
+    before = counters.get("corrupt_image")
+    with ingest.stream_batches(path, 3, transfer=False) as st:
+        got = [n for b in st for n in b.names]
+    assert counters.get("corrupt_image") - before == 2
+    assert st.stats.skipped == 2
+    assert got == [n for i, n in enumerate(names) if i not in (2, 4)]
+
+
+def test_transfer_stage_yields_device_batches(tar_uniform):
+    path, _ = tar_uniform
+    with ingest.stream_batches(path, 4) as st:
+        for batch in st:
+            assert batch.device is not None
+            assert isinstance(batch.device, jax.Array)
+            np.testing.assert_array_equal(
+                np.asarray(batch.device), batch.host
+            )
+
+
+def test_decode_ahead_env(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_DECODE_AHEAD", "3")
+    assert image_loaders.decode_ahead() == 3
+    monkeypatch.setenv("KEYSTONE_DECODE_AHEAD", "")
+    assert image_loaders.decode_ahead() == image_loaders._DECODE_AHEAD
+    monkeypatch.setenv("KEYSTONE_DECODE_AHEAD", "nope")
+    with pytest.raises(ValueError):
+        image_loaders.decode_ahead()
+    monkeypatch.setenv("KEYSTONE_DECODE_AHEAD", "-1")
+    with pytest.raises(ValueError):
+        image_loaders.decode_ahead()
+
+
+def test_ring_capacity_env(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_RING_CAPACITY", "7")
+    assert ingest.ring_capacity() == 7
+    monkeypatch.setenv("KEYSTONE_RING_CAPACITY", "0")
+    with pytest.raises(ValueError):
+        ingest.ring_capacity()
+
+
+def test_streaming_features_equal_eager(tar_mixed):
+    """The acceptance oracle: streaming features bit-identical to the eager
+    decode-then-featurize path on the same tar fixture."""
+    path, _ = tar_mixed
+    feat = jax.jit(
+        lambda x: jnp.stack(
+            [jnp.mean(x, axis=(1, 2, 3)), jnp.max(x, axis=(1, 2, 3))], axis=1
+        )
+    )
+    eager = _eager(path)
+    images = [img for _, img in eager]
+    buckets = bucket_by_shape(images)
+    out_eager = np.zeros((len(images), 2), np.float32)
+    for _shape, (idx, batch) in buckets.items():
+        out_eager[idx] = np.asarray(feat(jnp.asarray(batch)))
+    with ingest.stream_batches(path, 3) as st:
+        out_stream, names = scatter_features_streaming(st, feat, 2)
+    assert names == [n for n, _ in eager]
+    np.testing.assert_array_equal(out_stream, out_eager)
+
+
+def test_stream_descriptor_buckets_match_eager_layout(tar_mixed):
+    path, _ = tar_mixed
+    per_image = jax.jit(lambda x: jnp.mean(x, axis=3))  # [b, H, W]
+    eager = _eager(path)
+    images = [img for _, img in eager]
+    eager_buckets = {
+        shape: (idx, np.asarray(per_image(jnp.asarray(batch))))
+        for shape, (idx, batch) in bucket_by_shape(images).items()
+    }
+    with ingest.stream_batches(path, 3) as st:
+        stream_buckets, names = stream_descriptor_buckets(st, per_image)
+    assert names == [n for n, _ in eager]
+    assert set(stream_buckets) == set(eager_buckets)
+    for shape, (idx_e, desc_e) in eager_buckets.items():
+        idx_s, desc_s = stream_buckets[shape]
+        np.testing.assert_array_equal(np.asarray(idx_s), np.asarray(idx_e))
+        np.testing.assert_array_equal(np.asarray(desc_s), desc_e)
+
+
+def test_stream_bucket_order_matches_eager_first_occurrence(tmp_path, rng):
+    """Bucket dict ORDER must equal eager first-occurrence order even when
+    a later shape completes its first batch earlier: seeded column
+    sampling (fv_common.sample_columns) iterates the dict from one rng, so
+    chunk-emission order would silently change PCA/GMM sampling."""
+    # shape A first at ordinal 0, but shape B fills a 3-batch first
+    sizes = [(48, 48), (64, 40), (64, 40), (64, 40), (48, 48), (48, 48)]
+    path = str(tmp_path / "order.tar")
+    _make_tar(path, sizes, rng)
+    per_image = jax.jit(lambda x: jnp.mean(x, axis=3))
+    eager_order = list(
+        bucket_by_shape([img for _, img in _eager(path)])
+    )
+    with ingest.stream_batches(path, 3) as st:
+        stream_buckets, _ = stream_descriptor_buckets(st, per_image)
+    assert list(stream_buckets) == eager_order == [(48, 48), (64, 40)]
+
+
+def test_hung_decoder_trips_deadline_not_deadlock(tar_uniform, monkeypatch):
+    """A decoder thread that hangs must surface as a typed DeadlineExceeded
+    on the consumer (resilience.deadline) — never a deadlocked ring."""
+    path, _ = tar_uniform
+    real = image_loaders.decode_image
+    calls = {"n": 0}
+
+    def hanging(data):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            time.sleep(2.5)  # outlives the watchdog budget below
+        return real(data)
+
+    monkeypatch.setattr(image_loaders, "decode_image", hanging)
+    st = ingest.stream_batches(path, 4, num_threads=2)
+    with pytest.raises(DeadlineExceeded):
+        with deadline(0.6, phase="ingest"):
+            for batch in st:
+                np.asarray(batch.host)
+    st.close()
+    # The producer abandons the hung future; only the one sleeping worker
+    # remains until its sleep ends — it must exit by then (no leak).
+    assert st._thread.is_alive() is False or st.join(5.0)
+    assert st.join(5.0)

@@ -13,6 +13,7 @@ Usage:
     python tools/chaos_run.py                       # the tier-1 seed set
     python tools/chaos_run.py --full                # the full seed set
     python tools/chaos_run.py --workload cifar      # RandomPatchCifar
+    python tools/chaos_run.py --stream              # streaming-ingest families
 
 Exit status is nonzero if ANY schedule violates the invariant.  The first
 stdout line is the machine-readable JSON record (truncation-proof, same
@@ -40,6 +41,12 @@ def main(argv=None) -> int:
         action="store_true",
         help="run the full seed set instead of the tier-1 subset",
     )
+    p.add_argument(
+        "--stream",
+        action="store_true",
+        help="run only the streaming-ingest fault schedules "
+        "(stream_corrupt / stream_hang families, core.ingest path)",
+    )
     p.add_argument("--workload", default="mnist", choices=("mnist", "cifar"))
     a = p.parse_args(argv)
 
@@ -49,6 +56,15 @@ def main(argv=None) -> int:
         seeds = (a.seed,)
     else:
         seeds = chaos.FULL_SEEDS if a.full else chaos.TIER1_SEEDS
+    if a.stream:
+        seeds = tuple(
+            s
+            for s in (chaos.FULL_SEEDS if a.seed is None else seeds)
+            if chaos.make_schedule(s).kind.startswith("stream_")
+        )
+        if not seeds:
+            print("no streaming schedules in the selected seed set")
+            return 1
 
     results = chaos.run_suite(seeds, workload=a.workload)
     violations = [
