@@ -53,7 +53,8 @@ import jax.numpy as jnp
 import numpy as np
 
 
-from keystone_tpu.core.resilience import counters as fault_counters
+from keystone_tpu.core import trace as ktrace
+import keystone_tpu.core.resilience  # noqa: F401 — adopts "faults" into ktrace.metrics
 from keystone_tpu.ops.fisher import FisherVector
 from keystone_tpu.ops.sift import SIFTExtractor
 from keystone_tpu.solvers.block import BlockLeastSquaresEstimator
@@ -1161,6 +1162,11 @@ def main():
     e2e = _guarded(bench_e2e_ingest, rng)
     at_scale = _guarded(bench_solve_at_scale, rng)
 
+    # ONE atomic registry snapshot feeds both the back-compat "faults" key
+    # and the full "metrics" section — two separate snapshot calls could
+    # disagree about a fault recorded between them.
+    metrics_snapshot = ktrace.metrics.snapshot()
+
     value = round(cifar["images_per_sec"] / n_chips, 2)
     prior = prior_bench_value("random_patch_cifar_featurize")
     mfu = (
@@ -1198,7 +1204,13 @@ def main():
         # corrupt-member skips, jitter recoveries, OOM step-downs,
         # skew-guard fallbacks... — so BENCH_r06+ rows show the faults the
         # numbers were earned under, not just the perf (empty dict = clean).
-        "faults": fault_counters.counts(),
+        # Kept as its own key for BENCH_r0x row continuity, sourced from
+        # the same atomic snapshot as "metrics" below.
+        "faults": metrics_snapshot["faults"],
+        # The unified metrics registry (core.trace): counters/gauges/
+        # histograms accumulated anywhere in the process, faults group
+        # included — every bench record carries the full metrics surface.
+        "metrics": metrics_snapshot,
         "extra_metrics": {
             "imagenet_fv_featurize": (
                 fv

@@ -56,6 +56,7 @@ import jax
 import numpy as np
 
 from ..loaders import image_loaders
+from . import trace
 from .resilience import counters
 
 _logger = logging.getLogger("keystone_tpu.ingest")
@@ -287,6 +288,22 @@ class IngestStream:
             except _FutureTimeout:
                 continue
 
+    def _submit_decode(self, pool, name: str, data: bytes):
+        """Submit one member's decode; when tracing is enabled each decode
+        becomes an ``ingest.decode`` span on ITS worker thread's timeline —
+        the parallel decode lanes are visible next to the consumer lane,
+        so decode/featurize overlap is a picture, not an inference.  The
+        module attribute is resolved at call time (the chaos harness
+        patches ``image_loaders.decode_image``)."""
+        if not trace.enabled():
+            return pool.submit(image_loaders.decode_image, data)
+
+        def traced(data=data, name=name):
+            with trace.span("ingest.decode", cat="ingest", member=name):
+                return image_loaders.decode_image(data)
+
+        return pool.submit(traced)
+
     def _produce(self):
         pool = ThreadPoolExecutor(
             max_workers=self._num_threads,
@@ -325,25 +342,42 @@ class IngestStream:
                 if len(imgs) >= self._batch_size:
                     self._emit(buckets.pop(key))
 
-            for name, data in image_loaders._iter_tar_members(self._path):
-                if self._ring.stopped:
-                    raise _Cancelled()
-                if self._keep is not None and not self._keep(name):
-                    continue
-                window.append(
-                    (name, pool.submit(image_loaders.decode_image, data))
+            with trace.span(
+                "ingest.produce", cat="ingest", path=self._path
+            ) as prod_sp:
+                try:
+                    for name, data in image_loaders._iter_tar_members(
+                        self._path
+                    ):
+                        if self._ring.stopped:
+                            raise _Cancelled()
+                        if self._keep is not None and not self._keep(name):
+                            continue
+                        window.append(
+                            (name, self._submit_decode(pool, name, data))
+                        )
+                        if len(window) >= self._num_threads + self._ahead:
+                            drain_one()
+                    while window:
+                        drain_one()
+                    # Flush the batch-size remainders (partial last batch
+                    # per shape), oldest bucket first for a deterministic
+                    # tail order.
+                    for bucket in sorted(
+                        buckets.values(), key=lambda b: b[0][0]
+                    ):
+                        self._emit(bucket)
+                    clean = True
+                except _Cancelled:
+                    # Consumer stopped the stream early — routine shutdown
+                    # (a supported path), not a producer failure: the span
+                    # marks it aborted rather than errored.
+                    prod_sp.set(aborted=True)
+                prod_sp.set(
+                    decoded=self.stats.decoded,
+                    skipped=self.stats.skipped,
+                    batches=self.stats.batches,
                 )
-                if len(window) >= self._num_threads + self._ahead:
-                    drain_one()
-            while window:
-                drain_one()
-            # Flush the batch-size remainders (partial last batch per
-            # shape), oldest bucket first for a deterministic tail order.
-            for bucket in sorted(buckets.values(), key=lambda b: b[0][0]):
-                self._emit(bucket)
-            clean = True
-        except _Cancelled:
-            pass
         except BaseException as e:  # noqa: BLE001 — surfaces on the consumer
             self._ring.fail(e)
         finally:
@@ -361,17 +395,37 @@ class IngestStream:
             host=np.stack(imgs),
         )
         self._chunk_counter += 1
-        if not self._ring.put(chunk):
+        # The put span's duration IS the backpressure stall: a full ring
+        # blocks here, and the trace shows the producer lane waiting.
+        with trace.span(
+            "ingest.ring_put", cat="ingest",
+            index=chunk.index, images=len(chunk),
+        ):
+            ok = self._ring.put(chunk)
+        if not ok:
             raise _Cancelled()
         self.stats.batches += 1
 
     # -- consumer side --------------------------------------------------------
 
+    def _yield_consumed(self, item):
+        """Yield one chunk under an ``ingest.consume`` span: the span runs
+        from the moment the consumer receives the chunk until it asks for
+        the next one — i.e. the consumer's featurize time for THAT chunk,
+        on the consumer thread's lane.  Decode spans on the worker lanes
+        running inside a consume span's interval ARE the overlap."""
+        with trace.span(
+            "ingest.consume", cat="ingest",
+            index=item.index, images=len(item),
+        ):
+            yield item
+
     def _drain(self):
         pending: collections.deque = collections.deque()
         try:
             while True:
-                item = self._ring.get()
+                with trace.span("ingest.ring_get", cat="ingest"):
+                    item = self._ring.get()
                 if item is _Ring._END:
                     break
                 if self._transfer:
@@ -381,9 +435,9 @@ class IngestStream:
                     item.device = jax.device_put(item.host)
                 pending.append(item)
                 if len(pending) >= DEVICE_BUFFERS:
-                    yield pending.popleft()
+                    yield from self._yield_consumed(pending.popleft())
             while pending:
-                yield pending.popleft()
+                yield from self._yield_consumed(pending.popleft())
         finally:
             self.close()
 

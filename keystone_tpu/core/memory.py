@@ -53,6 +53,7 @@ from typing import Any, Callable, Sequence
 
 import jax
 
+from . import trace
 from .resilience import counters
 
 _logger = logging.getLogger("keystone_tpu.memory")
@@ -236,6 +237,23 @@ class MemoryPlan:
         return out
 
 
+def _admission_event(plan: "MemoryPlan") -> "MemoryPlan":
+    """Every admission decision is a point event on the trace timeline:
+    charged bytes vs budget, per-chip mesh axes when in mesh mode — the
+    trace shows WHY a tier was denied next to the tier spans that ran.
+    The event args ARE ``plan.breakdown()`` (the same record bench emits),
+    so the two can never drift apart."""
+    trace.instant(
+        "hbm_admission",
+        **{
+            "label": plan.label,
+            "per_chip": plan.mesh_axes is not None,
+            **plan.breakdown(),
+        },
+    )
+    return plan
+
+
 _UNSET = object()
 # (fn, arg signature) -> dict of analysis numbers + compiled object;
 # admission is re-evaluated against the CURRENT budget on every call, but the
@@ -352,7 +370,7 @@ def plan_program(
     if budget is _UNSET:
         budget = hbm_budget()
     if budget is None and not require_analysis:
-        return MemoryPlan(
+        return _admission_event(MemoryPlan(
             label=label,
             admitted=True,
             reason=(
@@ -360,7 +378,7 @@ def plan_program(
                 f"{HBM_BUDGET_ENV} unset) — admission skipped"
             ),
             mesh_axes=dict(mesh.shape) if mesh is not None else None,
-        )
+        ))
 
     key = _cache_key(fn, args, kwargs)
     cached = _plan_cache.get(key)
@@ -399,7 +417,7 @@ def plan_program(
             error=cached["error"],
         )
         counters.record("hbm_preflight_denied", f"{label}: {plan.reason}")
-        return plan
+        return _admission_event(plan)
 
     reported = None
     if mesh is None:
@@ -463,7 +481,7 @@ def plan_program(
     )
     if not admitted:
         counters.record("hbm_preflight_denied", f"{label}: {reason}")
-    return plan
+    return _admission_event(plan)
 
 
 # -- OOM detection / recovery -------------------------------------------------
@@ -589,45 +607,57 @@ def run_ladder(label: str, tiers: Sequence[Tier], report: FitReport):
     """
     report.label = label
     last_oom: BaseException | None = None
-    for i, tier in enumerate(tiers):
-        floor = i == len(tiers) - 1
-        plan = tier.plan()
-        report.plans[tier.name] = plan
-        if plan.budget_bytes is not None:
-            report.budget_bytes = plan.budget_bytes
-        if not plan.admitted and not floor:
-            report.denials.append(tier.name)
-            _logger.info("%s: %s denied by preflight — %s", label, tier.name, plan.reason)
-            continue
-        if not plan.admitted and floor:
-            _logger.warning(
-                "%s: floor tier %s denied by preflight (%s) but nothing is "
-                "below it — attempting anyway",
-                label, tier.name, plan.reason,
-            )
-        try:
-            out = tier.run(plan)
-        except Exception as e:  # noqa: BLE001 — only OOM is retried
-            if not is_oom_error(e) or floor:
-                raise
-            report.oom_retries.append(tier.name)
-            counters.record(
-                "solver_oom_retry",
-                f"{label}/{tier.name}: RESOURCE_EXHAUSTED at runtime "
-                f"(preflight said: {plan.reason}) — stepping down one tier",
-            )
-            last_oom = e
-            continue
-        report.chosen = tier.name
-        if report.degraded() or tier.name != tiers[0].name:
-            counters.record("solver_tier_degraded", report.summary())
-        _logger.info("%s: running tier=%s (%s)", label, tier.name, plan.reason)
-        return out
-    # Unreachable in practice (the floor either returns or raises), but be
-    # explicit if a caller builds a ladder whose floor denied AND raised.
-    raise RuntimeError(
-        f"{label}: every ladder tier failed"
-    ) from last_oom
+    # The whole laddered solve is one span; each considered tier's plan and
+    # run are child spans, and the FitReport is linked into the solve span
+    # at exit — a trace shows which tiers were tried, denied, OOMed, and
+    # chosen, with the admission numbers alongside.
+    with trace.span(f"solve:{label}", cat="solve") as solve_sp:
+        for i, tier in enumerate(tiers):
+            floor = i == len(tiers) - 1
+            with trace.span(f"plan:{tier.name}", cat="solve", solve=label):
+                plan = tier.plan()
+            report.plans[tier.name] = plan
+            if plan.budget_bytes is not None:
+                report.budget_bytes = plan.budget_bytes
+            if not plan.admitted and not floor:
+                report.denials.append(tier.name)
+                _logger.info("%s: %s denied by preflight — %s", label, tier.name, plan.reason)
+                continue
+            if not plan.admitted and floor:
+                _logger.warning(
+                    "%s: floor tier %s denied by preflight (%s) but nothing is "
+                    "below it — attempting anyway",
+                    label, tier.name, plan.reason,
+                )
+            try:
+                with trace.span(
+                    f"tier:{tier.name}", cat="solve",
+                    solve=label, admitted=plan.admitted,
+                ):
+                    out = tier.run(plan)
+            except Exception as e:  # noqa: BLE001 — only OOM is retried
+                if not is_oom_error(e) or floor:
+                    raise
+                report.oom_retries.append(tier.name)
+                counters.record(
+                    "solver_oom_retry",
+                    f"{label}/{tier.name}: RESOURCE_EXHAUSTED at runtime "
+                    f"(preflight said: {plan.reason}) — stepping down one tier",
+                )
+                last_oom = e
+                continue
+            report.chosen = tier.name
+            if report.degraded() or tier.name != tiers[0].name:
+                counters.record("solver_tier_degraded", report.summary())
+            _logger.info("%s: running tier=%s (%s)", label, tier.name, plan.reason)
+            solve_sp.set(report=report.record())
+            return out
+        # Unreachable in practice (the floor either returns or raises), but
+        # be explicit if a caller builds a ladder whose floor denied AND
+        # raised.
+        raise RuntimeError(
+            f"{label}: every ladder tier failed"
+        ) from last_oom
 
 
 def log_fit_report(est, logger=None, label: str = "") -> None:

@@ -28,9 +28,13 @@ chained estimator first pushes the data through the upstream transformer.
 from __future__ import annotations
 
 import abc
+import dataclasses
+import time
 from typing import Any, Callable, Generic, Sequence, TypeVar
 
 import jax
+
+from . import trace
 
 A = TypeVar("A")
 B = TypeVar("B")
@@ -142,6 +146,76 @@ def transformer(fn: Callable) -> FunctionTransformer:
     return FunctionTransformer(fn)
 
 
+def _node_label(n: Transformer) -> str:
+    """Stable display name for a pipeline node (FunctionTransformers carry
+    their wrapped function's name)."""
+    name = getattr(n, "name", None)
+    if isinstance(name, str) and name:
+        return name
+    return type(n).__name__
+
+
+def _output_stats(out) -> tuple[int, str | None, tuple | None, int]:
+    """(total_bytes, dtype, shape, leaves) of a node output — a single
+    array reports its own dtype/shape, a pytree sums its array leaves."""
+    if hasattr(out, "nbytes") and hasattr(out, "shape"):
+        return int(out.nbytes), str(out.dtype), tuple(out.shape), 1
+    leaves = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(out)
+        if hasattr(leaf, "nbytes")
+    ]
+    return sum(int(leaf.nbytes) for leaf in leaves), None, None, len(leaves)
+
+
+@dataclasses.dataclass
+class NodeProfile:
+    """Measured profile of one pipeline node on one batch."""
+
+    index: int
+    name: str
+    seconds: float  #: wall time incl. device sync (when ``sync=True``)
+    output_bytes: int
+    dtype: str | None  #: None for multi-leaf (pytree) outputs
+    shape: tuple | None
+    leaves: int = 1
+
+    def record(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["shape"] = list(self.shape) if self.shape is not None else None
+        return out
+
+
+@dataclasses.dataclass
+class PipelineProfile:
+    """Per-node time + output-size profile of one ``Pipeline.profile`` run —
+    the KeystoneML sampling-profiler analog (PipelineRuntimeEstimator
+    measured exactly these two quantities per node to decide caching).  The
+    future cost-based auto-``Cacher`` optimizer consumes this: a node whose
+    recompute time is large relative to its output bytes is the one worth
+    materializing."""
+
+    nodes: list  #: list[NodeProfile], pipeline order
+    total_seconds: float
+    input_bytes: int
+    #: The final output batch (so profiling doubles as an application).
+    output: Any = dataclasses.field(default=None, repr=False, compare=False)
+
+    def record(self) -> dict:
+        return {
+            "total_seconds": round(self.total_seconds, 6),
+            "input_bytes": self.input_bytes,
+            "nodes": [n.record() for n in self.nodes],
+        }
+
+    def summary(self) -> str:
+        parts = [
+            f"{n.name}: {n.seconds * 1e3:.2f}ms -> {n.output_bytes}B"
+            for n in self.nodes
+        ]
+        return f"profile({self.total_seconds * 1e3:.2f}ms): " + " | ".join(parts)
+
+
 class Pipeline(Transformer):
     """Composition of transformers; itself a transformer (and a pytree).
 
@@ -168,6 +242,55 @@ class Pipeline(Transformer):
         for n in self.nodes:
             item = n.apply_item(item)
         return item
+
+    def profile(self, batch, sync: bool = True) -> PipelineProfile:
+        """Run the pipeline node-by-node on ``batch``, measuring each
+        node's wall time and output bytes/dtype/shape — the measured
+        per-node profile KeystoneML's cost-based optimizer caches/
+        materializes from.  ``sync=True`` (default) blocks on each node's
+        output so a node's time includes ITS device compute instead of
+        leaking into the next node's dispatch (eager per-node execution —
+        profile a representative batch, don't wrap this in ``jit``).
+
+        Each node is also a ``node:<name>`` trace span (under a
+        ``pipeline.profile`` parent) carrying the same numbers, so a
+        profile shows up in the ``KEYSTONE_TRACE`` timeline."""
+        profiles = []
+        in_bytes, _, _, _ = _output_stats(batch)
+        t_start = time.perf_counter()
+        with trace.span("pipeline.profile", nodes=len(self.nodes)):
+            for i, n in enumerate(self.nodes):
+                label = _node_label(n)
+                with trace.span(f"node:{label}", index=i) as sp:
+                    t0 = time.perf_counter()
+                    batch = n(batch)
+                    if sync:
+                        batch = jax.block_until_ready(batch)
+                    dt = time.perf_counter() - t0
+                    nbytes, dtype, shape, leaves = _output_stats(batch)
+                    sp.set(
+                        seconds=round(dt, 6),
+                        output_bytes=nbytes,
+                        dtype=dtype,
+                        shape=list(shape) if shape is not None else None,
+                    )
+                profiles.append(
+                    NodeProfile(
+                        index=i,
+                        name=label,
+                        seconds=dt,
+                        output_bytes=nbytes,
+                        dtype=dtype,
+                        shape=shape,
+                        leaves=leaves,
+                    )
+                )
+        return PipelineProfile(
+            nodes=profiles,
+            total_seconds=time.perf_counter() - t_start,
+            input_bytes=in_bytes,
+            output=batch,
+        )
 
     def __repr__(self):
         return "Pipeline(" + " >> ".join(repr(n) for n in self.nodes) + ")"

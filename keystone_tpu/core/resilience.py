@@ -37,6 +37,8 @@ from typing import Callable
 import jax
 import numpy as np
 
+from . import trace
+
 _logger = logging.getLogger("keystone_tpu.resilience")
 
 # Exception types treated as transient by default: filesystem hiccups,
@@ -173,6 +175,17 @@ class FaultCounters:
         with self._lock:
             self._counts[kind] = self._counts.get(kind, 0) + 1
             total = self._counts[kind]
+            # Every survived fault is also a point event on the trace
+            # timeline (no-op when tracing is disabled), so a trace shows
+            # WHEN each fault landed relative to the spans it interrupted.
+            # Emitted INSIDE the counter lock: any snapshot that observes
+            # this count is guaranteed the event is already buffered, so
+            # the chaos --trace verifier (counted fault -> trace event)
+            # can never see a torn pair.
+            trace.instant(
+                "fault", kind=kind, total=total,
+                **({"detail": detail[:200]} if detail else {}),
+            )
         _logger.warning(
             "%s #%d%s", kind, total, f": {detail}" if detail else ""
         )
@@ -181,6 +194,17 @@ class FaultCounters:
     def counts(self) -> dict[str, int]:
         with self._lock:
             return dict(self._counts)
+
+    def snapshot(self, reset: bool = False) -> dict[str, int]:
+        """Atomic copy of the counts; ``reset=True`` clears them under the
+        SAME lock acquisition.  Separate ``counts()`` + ``reset()`` calls
+        lose any fault recorded between them — every record emitter
+        (bench, chaos, the multichip dryrun) snapshots through here."""
+        with self._lock:
+            out = dict(self._counts)
+            if reset:
+                self._counts.clear()
+        return out
 
     def get(self, kind: str) -> int:
         with self._lock:
@@ -193,6 +217,10 @@ class FaultCounters:
 
 #: Process-wide fault ledger (loaders/image_loaders, loaders/native_decode).
 counters = FaultCounters()
+
+# The fault ledger rides along in every metrics snapshot as the "faults"
+# group — one atomic record captures perf metrics AND degradation events.
+trace.metrics.adopt("faults", counters)
 
 
 def numerics_guard_enabled() -> bool:

@@ -1,0 +1,502 @@
+"""Unified tracing & metrics: structured spans, a process-wide metrics
+registry, and Chrome-trace/JSONL exporters.
+
+KeystoneML's cost-based optimizer decides caching/materialization from
+*measured per-node profiles* (time + output size, PipelineRuntimeEstimator);
+tf.data lives on built-in per-stage metrics feeding autotuning.  Neither is
+possible while timing/counters are scattered across ``stage_timer``,
+``resilience.counters``, ``FitReport``, and ad-hoc ring stats with no shared
+schema.  This module is that shared substrate:
+
+* :func:`span` — a thread-safe context manager producing nested structured
+  spans: wall time, thread id, nesting depth/parent, arbitrary JSON-able
+  attributes (bytes/shape/dtype), optional device-sync time
+  (``sp.sync(value)`` runs ``jax.block_until_ready`` and records the
+  synced duration).  When tracing is disabled ``span()`` returns a shared
+  no-op singleton — no allocation, no lock, one attribute check.
+* :data:`metrics` — the process-wide registry unifying **counters**,
+  **gauges**, and **histograms** behind one API, with an atomic
+  :meth:`Metrics.snapshot`.  ``resilience.counters`` (the fault ledger)
+  rides along as an adopted group, so one snapshot captures both.
+* :func:`instant` — point events (admission decisions, fault counts) that
+  land in the same timeline as spans.
+* Exporters: **Chrome trace_event JSON** (loads in Perfetto / chrome://
+  tracing; the default for ``*.json`` paths) and a **JSONL event log**
+  (``*.jsonl``).  Enable with ``KEYSTONE_TRACE=out.json`` (checked once at
+  import; the file is written at process exit) or programmatically with
+  :func:`enable` / a workload's ``--trace`` flag.
+
+Overhead discipline: the disabled path is a module-bool check returning a
+cached null object — the tier-1 suite asserts zero retained allocation
+growth, and the bench acceptance bound is < 2% on ``stage_ops`` with
+tracing off.  Enabled, each finished span is one dict append under a lock
+(bounded at :data:`MAX_EVENTS`; overflow is counted, never unbounded).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import logging
+import os
+import threading
+import time
+
+_logger = logging.getLogger("keystone_tpu.trace")
+
+#: env var: path of the trace file to write at process exit ("out.json" for
+#: Chrome trace_event JSON viewable in Perfetto, "out.jsonl" for JSONL).
+TRACE_ENV = "KEYSTONE_TRACE"
+
+#: Hard cap on buffered events — a runaway span loop degrades to a counted
+#: drop (``metrics`` counter ``trace_events_dropped``, plus a drop field in
+#: both export formats), never unbounded RAM.
+MAX_EVENTS = 1_000_000
+
+_EPOCH = time.perf_counter()  # ts origin: microseconds since module import
+
+_lock = threading.Lock()
+_events: list = []
+_dropped = 0
+#: Bumped by reset(): a span that outlives the buffer it was opened in
+#: (e.g. an abandoned decoder thread finishing after a per-schedule
+#: chaos reset) must not leak into the NEXT buffer with a stale tid.
+_epoch = 0
+_enabled = False
+_path: str | None = None
+_tids: dict[int, int] = {}  # threading.get_ident() -> small sequential tid
+_tls = threading.local()  # per-thread span stack (nesting/parents)
+_atexit_registered = False
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _EPOCH) * 1e6
+
+
+def _tid() -> int:
+    """Small sequential id for the calling thread; first sight also emits
+    the Chrome ``thread_name`` metadata event so Perfetto labels lanes."""
+    ident = threading.get_ident()
+    tid = _tids.get(ident)
+    if tid is None:
+        with _lock:
+            tid = _tids.get(ident)
+            if tid is None:
+                tid = len(_tids)
+                _tids[ident] = tid
+                _events.append(
+                    {
+                        "ph": "M",
+                        "name": "thread_name",
+                        "pid": os.getpid(),
+                        "tid": tid,
+                        "args": {"name": threading.current_thread().name},
+                    }
+                )
+    return tid
+
+
+def _stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _record(event: dict) -> None:
+    global _dropped
+    with _lock:
+        if len(_events) >= MAX_EVENTS:
+            _dropped += 1
+            overflow = True
+        else:
+            _events.append(event)
+            overflow = False
+    if overflow:
+        # Counted OUTSIDE the trace lock (metrics has its own) so the
+        # truncation shows up in every metrics snapshot, not just the
+        # exporters' drop fields.
+        metrics.inc("trace_events_dropped")
+
+
+class _NullSpan:
+    """The disabled-mode span: a shared, allocation-free no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+    def sync(self, value):
+        return value
+
+
+_NULL = _NullSpan()
+
+
+class Span:
+    """One live span (use via ``with trace.span(...) as sp``)."""
+
+    __slots__ = (
+        "name", "cat", "attrs", "t0", "_tid", "_depth", "_parent", "_epoch"
+    )
+
+    def __init__(self, name: str, cat: str, attrs: dict):
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+        self.t0 = 0.0
+        self._tid = 0
+        self._depth = 0
+        self._parent = None
+        self._epoch = 0
+
+    def __enter__(self):
+        stack = _stack()
+        self._depth = len(stack)
+        self._parent = stack[-1].name if stack else None
+        stack.append(self)
+        self._tid = _tid()
+        self._epoch = _epoch
+        self.t0 = _now_us()
+        return self
+
+    def __exit__(self, etype, exc, tb):
+        t1 = _now_us()
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:  # exited out of order (generator close) — heal
+            stack.remove(self)
+        if self._epoch != _epoch:
+            # The buffer this span was opened in was reset (per-schedule
+            # chaos traces): a straggler from an abandoned thread must not
+            # land in the NEXT trace with a stale tid.
+            return False
+        args = dict(self.attrs)
+        args["depth"] = self._depth
+        if self._parent is not None:
+            args["parent"] = self._parent
+        if etype is not None:
+            if issubclass(etype, GeneratorExit):
+                # A generator-hosted span (ingest.consume) is closed — not
+                # failed — when the consumer stops early or raises outside
+                # the generator frame; naming GeneratorExit as the error
+                # would mask the consumer's real failure, which lands on
+                # whatever span wraps the consumer code.
+                args["aborted"] = True
+            else:
+                # Typed-error spans are never silent: the failure rides in
+                # the span itself, matchable against the fault counters.
+                args["error"] = etype.__name__
+        _record(
+            {
+                "ph": "X",
+                "name": self.name,
+                "cat": self.cat,
+                "ts": self.t0,
+                "dur": max(t1 - self.t0, 0.0),
+                "pid": os.getpid(),
+                "tid": self._tid,
+                "args": args,
+            }
+        )
+        return False
+
+    def set(self, **attrs) -> "Span":
+        """Attach attributes (bytes, shapes, reports) to the span."""
+        self.attrs.update(attrs)
+        return self
+
+    def sync(self, value):
+        """``jax.block_until_ready(value)`` and record the device-sync
+        time (span start -> sync completion) as ``sync_us``.  Returns
+        ``value`` so call sites stay expression-shaped."""
+        import jax
+
+        value = jax.block_until_ready(value)
+        self.attrs["sync_us"] = round(_now_us() - self.t0, 1)
+        return value
+
+
+def span(name: str, cat: str = "span", **attrs):
+    """Open a structured span.  Disabled tracing returns a shared no-op —
+    the hot-path cost is one module-bool check."""
+    if not _enabled:
+        return _NULL
+    return Span(name, cat, attrs)
+
+
+def instant(name: str, **attrs) -> None:
+    """Point event (admission decision, fault count) on the current
+    thread's timeline.
+
+    No epoch guard, deliberately (unlike spans): an instant is wholly
+    inside the CURRENT buffer's lifetime — a straggler thread firing one
+    after a reset() records an event that really happened now, and the
+    matching counter increment lands in the same window's delta, so the
+    chaos verifier's counted-fault -> trace-event pairing stays
+    consistent.  A span, by contrast, opened before the reset would carry
+    a stale tid/interval, which is why Span.__exit__ drops it."""
+    if not _enabled:
+        return
+    _record(
+        {
+            "ph": "i",
+            "s": "t",
+            "name": name,
+            "cat": "instant",
+            "ts": _now_us(),
+            "pid": os.getpid(),
+            "tid": _tid(),
+            "args": attrs,
+        }
+    )
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(path: str) -> None:
+    """Turn tracing on, writing to ``path`` at :func:`flush` / process
+    exit.  ``*.jsonl`` selects the JSONL event log; anything else writes
+    Chrome trace_event JSON (Perfetto-loadable)."""
+    global _enabled, _path, _atexit_registered
+    # Fail fast on an unwritable destination: flush() runs at the END of a
+    # (possibly hours-long) run — discovering a missing directory there
+    # would lose the whole trace.
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    if not os.access(parent, os.W_OK):
+        raise PermissionError(f"trace path directory {parent!r} not writable")
+    with _lock:
+        _path = path
+        _enabled = True
+        if not _atexit_registered:
+            atexit.register(_flush_at_exit)
+            _atexit_registered = True
+    _logger.info("tracing enabled -> %s", path)
+
+
+def disable() -> None:
+    """Stop recording (buffered events are kept until :func:`reset`)."""
+    global _enabled
+    _enabled = False
+
+
+def reset() -> None:
+    """Drop every buffered event (test isolation; per-schedule traces).
+    Spans still open when reset is called belong to the OLD buffer and are
+    discarded at their exit (epoch check), never recorded into the new
+    one."""
+    global _dropped, _epoch
+    with _lock:
+        _events.clear()
+        _tids.clear()
+        _dropped = 0
+        _epoch += 1
+
+
+def events() -> list:
+    """Snapshot (copy) of the buffered events."""
+    with _lock:
+        return list(_events)
+
+
+def flush(path: str | None = None) -> str | None:
+    """Write the buffered events to ``path`` (default: the enabled path).
+    Chrome format for ``*.json``, JSONL for ``*.jsonl``.  Returns the
+    path written, or None when there is nowhere to write."""
+    path = path or _path
+    if path is None:
+        return None
+    with _lock:
+        evs = list(_events)
+        dropped = _dropped
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        if path.endswith(".jsonl"):
+            for ev in evs:
+                f.write(json.dumps(ev) + "\n")
+            if dropped:
+                # Truncation must be visible in THIS format too, not just
+                # the Chrome JSON's otherData field.
+                f.write(
+                    json.dumps(
+                        {"ph": "M", "name": "dropped_events",
+                         "pid": os.getpid(), "tid": 0,
+                         "args": {"count": dropped}}
+                    ) + "\n"
+                )
+        else:
+            json.dump(
+                {
+                    "traceEvents": evs,
+                    "displayTimeUnit": "ms",
+                    "otherData": {
+                        "producer": "keystone_tpu.core.trace",
+                        "dropped_events": dropped,
+                    },
+                },
+                f,
+            )
+    os.replace(tmp, path)
+    return path
+
+
+def _flush_at_exit() -> None:
+    try:
+        if _path is not None and (_events or _enabled):
+            flush()
+    except Exception:  # noqa: BLE001 — never break interpreter shutdown
+        _logger.exception("trace flush at exit failed")
+
+
+# -- metrics registry ---------------------------------------------------------
+
+
+class _Hist:
+    """Streaming histogram: count/sum/min/max plus a bounded sample window
+    for percentiles (last :data:`_HIST_WINDOW` observations)."""
+
+    _WINDOW = 1024
+    __slots__ = ("count", "total", "min", "max", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.samples: collections.deque = collections.deque(maxlen=self._WINDOW)
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        self.samples.append(value)
+
+    def summary(self) -> dict:
+        if not self.count:
+            return {"count": 0}
+        s = sorted(self.samples)
+        pick = lambda q: s[min(len(s) - 1, int(q * len(s)))]  # noqa: E731
+        return {
+            "count": self.count,
+            "mean": self.total / self.count,
+            "min": self.min,
+            "max": self.max,
+            "p50": pick(0.50),
+            "p90": pick(0.90),
+            "p99": pick(0.99),
+        }
+
+
+class Metrics:
+    """Thread-safe registry of counters, gauges, and histograms.
+
+    External counter groups with their own lock (``resilience.counters``)
+    are *adopted*: they keep their API and storage, and ride along in
+    every :meth:`snapshot` under their group name — one snapshot captures
+    the whole process's metrics surface atomically per group.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, _Hist] = {}
+        self._groups: dict[str, object] = {}
+
+    # counters ---------------------------------------------------------------
+    def inc(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._counters[name] = total = self._counters.get(name, 0) + n
+        return total
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return dict(self._counters)
+
+    # gauges -----------------------------------------------------------------
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    # histograms -------------------------------------------------------------
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Hist()
+            h.observe(value)
+
+    # groups -----------------------------------------------------------------
+    def adopt(self, name: str, group) -> None:
+        """Register an external counter group (must expose
+        ``snapshot(reset=False) -> dict``) under ``name``."""
+        with self._lock:
+            self._groups[name] = group
+
+    # snapshot ---------------------------------------------------------------
+    def snapshot(self, reset: bool = False) -> dict:
+        """Atomic copy of every counter/gauge/histogram (and each adopted
+        group via ITS own atomic snapshot).  ``reset=True`` clears the
+        registry under the same lock — read-then-reset can never lose a
+        concurrent increment."""
+        with self._lock:
+            out = {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.summary() for k, h in self._hists.items()},
+            }
+            groups = dict(self._groups)
+            if reset:
+                self._counters.clear()
+                self._gauges.clear()
+                self._hists.clear()
+        for name, group in groups.items():
+            out[name] = group.snapshot(reset=reset)
+        return out
+
+    def reset(self) -> None:
+        self.snapshot(reset=True)
+
+
+#: Process-wide registry.  ``resilience.counters`` adopts itself in as the
+#: "faults" group, so ``metrics.snapshot()`` captures perf metrics and the
+#: fault ledger in one record (bench embeds exactly this).
+metrics = Metrics()
+
+
+# -- env activation -----------------------------------------------------------
+
+_env_path = os.environ.get(TRACE_ENV, "").strip()
+if _env_path:
+    try:
+        enable(_env_path)
+    except OSError as e:
+        # A bad env var must not make the whole package unimportable for
+        # tools that never asked to trace — but the user who DID ask gets
+        # told on stderr (the logger tree has no handler this early).
+        import sys as _sys
+
+        _sys.stderr.write(
+            f"keystone_tpu: {TRACE_ENV}={_env_path!r} is unusable ({e}) — "
+            "tracing disabled\n"
+        )
+        _logger.error(
+            "%s=%r unusable (%s) — tracing disabled", TRACE_ENV, _env_path, e
+        )

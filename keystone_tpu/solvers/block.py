@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import memory as kmem
+from ..core import trace
 from ..core.checkpoint import CheckpointError, _atomic_write_bytes
 from ..core.pipeline import Identity, LabelEstimator, Transformer
 from ..ops.stats import StandardScalerModel
@@ -757,16 +758,22 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 if isinstance(resume_from, str)
                 else resume_from
             )
-            models, label_mean, means = _stepwise_bcd_fit(
-                jnp.asarray(x),
-                jnp.asarray(labels),
-                self.lam,
-                nvalid,
-                self.num_iter,
-                widths,
-                checkpoint_cb=cb,
-                resume_state=state,
-            )
+            # The checkpoint/resume path bypasses run_ladder (its tier is
+            # forced), so it emits its own tier span with the report linked.
+            with trace.span(
+                "tier:stepwise[checkpoint]", cat="solve", solve="bcd_fit",
+                resuming=state is not None,
+            ):
+                models, label_mean, means = _stepwise_bcd_fit(
+                    jnp.asarray(x),
+                    jnp.asarray(labels),
+                    self.lam,
+                    nvalid,
+                    self.num_iter,
+                    widths,
+                    checkpoint_cb=cb,
+                    resume_state=state,
+                )
         elif mesh is not None:
             # Multi-chip path: the MESH degradation ladder — full
             # (data, model) mesh with per-chip admission, then the

@@ -46,6 +46,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import dataclasses
 
 from ..core import memory as kmem
+from ..core import trace
 from ..core.pipeline import LabelEstimator
 from ..core.resilience import counters
 from ..parallel.mesh import (
@@ -585,20 +586,23 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         # Class of each valid row: device argmax for device labels, so only
         # the [n] int vector crosses to host (round 2 pulled the whole
         # design matrix); plain numpy argmax for host labels.
-        if isinstance(labels, jax.Array):
-            class_idx = np.asarray(jnp.argmax(labels[:n], axis=1))
-        else:
-            class_idx = np.argmax(np.asarray(labels)[:n], axis=1)
-        counts_np = np.bincount(class_idx, minlength=n_classes)
-        if np.any(counts_np == 0):
-            missing = np.nonzero(counts_np == 0)[0]
-            raise ValueError(f"classes with no examples: {missing.tolist()}")
+        with trace.span("bwls.class_sort", cat="solve", n=n, classes=n_classes):
+            if isinstance(labels, jax.Array):
+                class_idx = np.asarray(jnp.argmax(labels[:n], axis=1))
+            else:
+                class_idx = np.argmax(np.asarray(labels)[:n], axis=1)
+            counts_np = np.bincount(class_idx, minlength=n_classes)
+            if np.any(counts_np == 0):
+                missing = np.nonzero(counts_np == 0)[0]
+                raise ValueError(
+                    f"classes with no examples: {missing.tolist()}"
+                )
 
-        # Class grouping (the reference's HashPartitioner shuffle +
-        # per-partition id sort, :324-361): a host argsort of the [n] class
-        # vector gives the permutation; rows move device-side via one
-        # regroup of the whole design matrix below.
-        order = np.argsort(class_idx, kind="stable")
+            # Class grouping (the reference's HashPartitioner shuffle +
+            # per-partition id sort, :324-361): a host argsort of the [n]
+            # class vector gives the permutation; rows move device-side via
+            # one regroup of the whole design matrix below.
+            order = np.argsort(class_idx, kind="stable")
         starts_np = np.concatenate([[0], np.cumsum(counts_np)[:-1]])
         n_max = int(counts_np.max())
 

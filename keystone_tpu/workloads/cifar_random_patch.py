@@ -34,8 +34,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import trace
 from ..core.ingest import stream_batches
-from ..core.logging import Logging, configure_logging
+from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
 from ..core.pipeline import Pipeline
 from ..core.resilience import assert_all_finite, numerics_guard_enabled
@@ -273,7 +274,8 @@ def run(
         keep = rng.random(len(train)) < conf.sample_frac
         train = LabeledImageBatch(train.images[keep], train.labels[keep])
 
-    filters, whitener = learn_filters(conf, train.images)
+    with stage_timer("learn_filters"):
+        filters, whitener = learn_filters(conf, train.images)
     conv_pipe = build_conv_pipeline(conf, filters, whitener)
     feat_fn = jax.jit(conv_pipe.__call__)
 
@@ -291,10 +293,11 @@ def run(
     feat_fn(warm).block_until_ready()
 
     t_feat = time.perf_counter()
-    train_conv = featurize_chunked(
-        feat_fn, train.images, conf.featurize_chunk, mesh=mesh
-    )
-    train_conv.block_until_ready()
+    with stage_timer("featurize"):
+        train_conv = featurize_chunked(
+            feat_fn, train.images, conf.featurize_chunk, mesh=mesh
+        )
+        train_conv.block_until_ready()
     feat_secs = time.perf_counter() - t_feat
 
     # StandardScaler fit on train features (thenEstimator, reference :58)
@@ -302,47 +305,51 @@ def run(
     train_features = scaler(train_conv)
 
     labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
-    solver = BlockLeastSquaresEstimator(4096, 1, conf.lam or 0.0, mesh=mesh)
-    model = solver.fit(
-        train_features,
-        labels,
-        checkpoint=conf.solve_checkpoint,
-        resume_from=conf.solve_resume,
-    )
-    log_fit_report(solver, label="cifar random-patch solve")
-    if numerics_guard_enabled():
-        # Typed failure (FloatingPointError) instead of NaN predictions.
-        assert_all_finite(model, "cifar random-patch model")
+    with stage_timer("solve"):
+        solver = BlockLeastSquaresEstimator(4096, 1, conf.lam or 0.0, mesh=mesh)
+        model = solver.fit(
+            train_features,
+            labels,
+            checkpoint=conf.solve_checkpoint,
+            resume_from=conf.solve_resume,
+        )
+        log_fit_report(solver, label="cifar random-patch solve")
+        if numerics_guard_enabled():
+            # Typed failure (FloatingPointError) instead of NaN predictions.
+            assert_all_finite(model, "cifar random-patch model")
 
     def predict(features):
         return MaxClassifier()(model(features))
 
-    train_pred = predict(train_features)
-    train_eval = MulticlassClassifierEvaluator(
-        train_pred, train.labels, conf.num_classes
-    )
+    with stage_timer("eval"):
+        train_pred = predict(train_features)
+        train_eval = MulticlassClassifierEvaluator(
+            train_pred, train.labels, conf.num_classes
+        )
 
-    if conf.stream_test_tar is not None:
-        # Streaming ingest: JPEG decode of the next chunk overlaps the
-        # conv featurize of the current one (core.ingest ring buffer +
-        # double-buffered H2D); labels ride in the member names.
-        with stream_batches(
-            conf.stream_test_tar, conf.featurize_chunk
-        ) as st:
-            test_feats, names = featurize_stream(
-                feat_fn, st, conf.featurize_chunk
+        if conf.stream_test_tar is not None:
+            # Streaming ingest: JPEG decode of the next chunk overlaps the
+            # conv featurize of the current one (core.ingest ring buffer +
+            # double-buffered H2D); labels ride in the member names.
+            with stream_batches(
+                conf.stream_test_tar, conf.featurize_chunk
+            ) as st:
+                test_feats, names = featurize_stream(
+                    feat_fn, st, conf.featurize_chunk
+                )
+            test_labels = np.asarray(
+                [cifar_tar_label(n) for n in names], np.int32
             )
-        test_labels = np.asarray(
-            [cifar_tar_label(n) for n in names], np.int32
+            test_pred = predict(scaler(jnp.asarray(test_feats)))
+        else:
+            test_labels = test.labels
+            test_conv = featurize_chunked(
+                feat_fn, test.images, conf.featurize_chunk, mesh=mesh
+            )
+            test_pred = predict(scaler(test_conv))
+        test_eval = MulticlassClassifierEvaluator(
+            test_pred, test_labels, conf.num_classes
         )
-        test_pred = predict(scaler(jnp.asarray(test_feats)))
-    else:
-        test_labels = test.labels
-        test_conv = featurize_chunked(
-            feat_fn, test.images, conf.featurize_chunk, mesh=mesh
-        )
-        test_pred = predict(scaler(test_conv))
-    test_eval = MulticlassClassifierEvaluator(test_pred, test_labels, conf.num_classes)
 
     secs = time.perf_counter() - t0
     results = {
@@ -390,7 +397,19 @@ def main(argv=None):
         default=None,
         help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
     )
+    p.add_argument(
+        "--trace",
+        default=None,
+        metavar="PATH",
+        help="write a Chrome-trace JSON (Perfetto-loadable; .jsonl for the "
+        "JSONL event log) of the run — the KEYSTONE_TRACE env equivalent",
+    )
     a = p.parse_args(argv)
+    if a.trace:
+        trace.enable(a.trace)
+    # Before the load stage timer, so its log line has a handler to land on
+    # (run() re-applies the same idempotent configuration).
+    configure_logging()
     conf = RandomCifarConfig(
         train_location=a.trainLocation,
         test_location=a.testLocation,
@@ -415,17 +434,23 @@ def main(argv=None):
             return cifar_tar_loader(location)
         return cifar_loader(location)
 
-    train = load_split(conf.train_location)
-    if a.streamTestTar is not None:
-        # streamed test split: run() never touches the eager test batch —
-        # loading --testLocation too would decode a tar just to discard it
-        test = LabeledImageBatch(
-            np.zeros((0,) + train.images.shape[1:], np.float32),
-            np.zeros(0, np.int32),
-        )
-    else:
-        test = load_split(a.testLocation)
-    return run(conf, train, test, mesh=parse_mesh(a.mesh))
+    with stage_timer("load"):
+        train = load_split(conf.train_location)
+        if a.streamTestTar is not None:
+            # streamed test split: run() never touches the eager test
+            # batch — loading --testLocation too would decode a tar just
+            # to discard it
+            test = LabeledImageBatch(
+                np.zeros((0,) + train.images.shape[1:], np.float32),
+                np.zeros(0, np.int32),
+            )
+        else:
+            test = load_split(a.testLocation)
+    try:
+        return run(conf, train, test, mesh=parse_mesh(a.mesh))
+    finally:
+        if a.trace:
+            trace.flush()
 
 
 if __name__ == "__main__":

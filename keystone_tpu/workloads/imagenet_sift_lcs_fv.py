@@ -19,9 +19,10 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import trace
 from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
 from ..core.ingest import stream_batches
-from ..core.logging import Logging, configure_logging
+from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
 from ..core.resilience import assert_all_finite
 from ..loaders.image_loaders import (
@@ -309,26 +310,28 @@ def run(
             np.concatenate([test_sift, test_lcs], axis=1)
         )
     else:
-        train_sift, test_sift, sift_pca, sift_gmm = branch_features(
-            conf,
-            train.images,
-            test.images,
-            sift_descriptor_buckets,
-            conf.sift_pca_file,
-            (conf.sift_gmm_mean_file, conf.sift_gmm_var_file, conf.sift_gmm_wts_file),
-            conf.seed,
-            mesh,
-        )
-        train_lcs, test_lcs, lcs_pca, lcs_gmm = branch_features(
-            conf,
-            train.images,
-            test.images,
-            lcs_descriptor_buckets,
-            conf.lcs_pca_file,
-            (conf.lcs_gmm_mean_file, conf.lcs_gmm_var_file, conf.lcs_gmm_wts_file),
-            conf.seed + 100,
-            mesh,
-        )
+        with stage_timer("sift_branch"):
+            train_sift, test_sift, sift_pca, sift_gmm = branch_features(
+                conf,
+                train.images,
+                test.images,
+                sift_descriptor_buckets,
+                conf.sift_pca_file,
+                (conf.sift_gmm_mean_file, conf.sift_gmm_var_file, conf.sift_gmm_wts_file),
+                conf.seed,
+                mesh,
+            )
+        with stage_timer("lcs_branch"):
+            train_lcs, test_lcs, lcs_pca, lcs_gmm = branch_features(
+                conf,
+                train.images,
+                test.images,
+                lcs_descriptor_buckets,
+                conf.lcs_pca_file,
+                (conf.lcs_gmm_mean_file, conf.lcs_gmm_var_file, conf.lcs_gmm_wts_file),
+                conf.seed + 100,
+                mesh,
+            )
 
         # ZipVectors (:179-183) — kept host-side; the solver shards its blocks
         train_features = np.concatenate([train_sift, train_lcs], axis=1)
@@ -337,15 +340,16 @@ def run(
         labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
 
         # 2·2·descDim·vocabSize features (:186-188)
-        solver = BlockWeightedLeastSquaresEstimator(
-            4096, 1, conf.lam, conf.mixture_weight, mesh=mesh
-        )
-        model = solver.fit(
-            train_features, labels,
-            num_features=2 * 2 * conf.desc_dim * conf.vocab_size,
-        )
-        log_fit_report(solver, label="ImageNet weighted block solve")
-        assert_all_finite(model, "ImageNet weighted block solve")
+        with stage_timer("solve"):
+            solver = BlockWeightedLeastSquaresEstimator(
+                4096, 1, conf.lam, conf.mixture_weight, mesh=mesh
+            )
+            model = solver.fit(
+                train_features, labels,
+                num_features=2 * 2 * conf.desc_dim * conf.vocab_size,
+            )
+            log_fit_report(solver, label="ImageNet weighted block solve")
+            assert_all_finite(model, "ImageNet weighted block solve")
 
         if conf.pipeline_file is not None:
             save_pipeline(
@@ -360,10 +364,11 @@ def run(
             )
             log.log_info("saved fitted pipeline to %s", conf.pipeline_file)
 
-    test_scores = model(test_features)
-    k = min(5, conf.num_classes)
-    topk = np.asarray(TopKClassifier(k)(test_scores))
-    err = get_err_percent(topk, test.labels, k)
+    with stage_timer("eval"):
+        test_scores = model(test_features)
+        k = min(5, conf.num_classes)
+        topk = np.asarray(TopKClassifier(k)(test_scores))
+        err = get_err_percent(topk, test.labels, k)
     results = {
         "top5_err_percent": err,
         "top1_err_percent": get_err_percent(topk, test.labels, 1),
@@ -412,12 +417,21 @@ def main(argv=None):
         default=None,
         help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
     )
+    p.add_argument(
+        "--trace",
+        default=None,
+        metavar="PATH",
+        help="write a Chrome-trace JSON (Perfetto-loadable; .jsonl for the "
+        "JSONL event log) of the run — the KEYSTONE_TRACE env equivalent",
+    )
     for flag in (
         "siftPcaFile", "siftGmmMeanFile", "siftGmmVarFile", "siftGmmWtsFile",
         "lcsPcaFile", "lcsGmmMeanFile", "lcsGmmVarFile", "lcsGmmWtsFile",
     ):
         p.add_argument(f"--{flag}", default=None)
     a = p.parse_args(argv)
+    if a.trace:
+        trace.enable(a.trace)
     conf = ImageNetSiftLcsFVConfig(
         train_location=a.trainLocation,
         test_location=a.testLocation,
@@ -459,7 +473,11 @@ def main(argv=None):
         )
     else:
         test = imagenet_loader(conf.test_location, conf.label_path)
-    return run(conf, train, test, mesh=parse_mesh(a.mesh))
+    try:
+        return run(conf, train, test, mesh=parse_mesh(a.mesh))
+    finally:
+        if a.trace:
+            trace.flush()
 
 
 if __name__ == "__main__":

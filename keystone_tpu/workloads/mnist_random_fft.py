@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.logging import Logging, configure_logging
+from ..core import trace
+from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
 from ..core.pipeline import Pipeline
 from ..core.resilience import assert_all_finite, numerics_guard_enabled
@@ -101,26 +102,33 @@ def run(
         train_data, nvalid = jnp.asarray(train.data), None
         test_data = jnp.asarray(test.data)
 
-    training_batches = [
-        ZipVectors.apply([chain(train_data) for chain in chains])
-        for chains in batch_featurizer
-    ]
+    with stage_timer("featurize"):
+        training_batches = [
+            ZipVectors.apply([chain(train_data) for chain in chains])
+            for chains in batch_featurizer
+        ]
+        # Sync inside the stage: jnp dispatch is async, and an unsynced
+        # featurize span would read ~0 while the compute leaked into the
+        # solve span's time.
+        jax.block_until_ready(training_batches)
 
-    solver = BlockLeastSquaresEstimator(
-        conf.block_size, 1, conf.lam or 0.0, mesh=mesh
-    )
-    model = solver.fit(
-        training_batches,
-        labels,
-        nvalid=nvalid,
-        checkpoint=conf.solve_checkpoint,
-        resume_from=conf.solve_resume,
-    )
-    log_fit_report(solver, label="mnist random-fft solve")
-    if numerics_guard_enabled():
-        # Fail typed (FloatingPointError) instead of serving NaN scores —
-        # a poisoned batch or diverged solve must never look like a model.
-        assert_all_finite(model, "mnist random-fft model")
+    with stage_timer("solve"):
+        solver = BlockLeastSquaresEstimator(
+            conf.block_size, 1, conf.lam or 0.0, mesh=mesh
+        )
+        model = solver.fit(
+            training_batches,
+            labels,
+            nvalid=nvalid,
+            checkpoint=conf.solve_checkpoint,
+            resume_from=conf.solve_resume,
+        )
+        log_fit_report(solver, label="mnist random-fft solve")
+        if numerics_guard_enabled():
+            # Fail typed (FloatingPointError) instead of serving NaN
+            # scores — a poisoned batch or diverged solve must never look
+            # like a model.
+            assert_all_finite(model, "mnist random-fft model")
 
     test_batches = [
         ZipVectors.apply([chain(test_data) for chain in chains])
@@ -147,8 +155,9 @@ def run(
 
     # Streaming evaluation after each block, as the reference does (:70-86);
     # the last invocation sees the full-model prediction.
-    model.apply_and_evaluate(training_batches, train_eval)
-    model.apply_and_evaluate(test_batches, test_eval)
+    with stage_timer("eval"):
+        model.apply_and_evaluate(training_batches, train_eval)
+        model.apply_and_evaluate(test_batches, test_eval)
 
     results["seconds"] = time.perf_counter() - t0
     log.log_info("Pipeline took %.3f s", results["seconds"])
@@ -183,7 +192,19 @@ def main(argv=None):
         default=None,
         help="BCD solve state path to resume a preempted fit from",
     )
+    p.add_argument(
+        "--trace",
+        default=None,
+        metavar="PATH",
+        help="write a Chrome-trace JSON (Perfetto-loadable; .jsonl for the "
+        "JSONL event log) of the run — the KEYSTONE_TRACE env equivalent",
+    )
     a = p.parse_args(argv)
+    if a.trace:
+        trace.enable(a.trace)
+    # Before the load stage timer, so its log line has a handler to land on
+    # (run() re-applies the same idempotent configuration).
+    configure_logging()
     if a.blockSize <= 0 or a.blockSize % 512 != 0:
         p.error("--blockSize must be a positive multiple of 512")
     conf = MnistRandomFFTConfig(
@@ -197,13 +218,22 @@ def main(argv=None):
         solve_resume=a.resumeFrom,
     )
     # Labels in the files are 1-indexed (reference :40-42)
-    train = LabeledData.from_rows(csv_data_loader(conf.train_location), one_indexed=True)
-    test = LabeledData.from_rows(csv_data_loader(conf.test_location), one_indexed=True)
+    with stage_timer("load"):
+        train = LabeledData.from_rows(
+            csv_data_loader(conf.train_location), one_indexed=True
+        )
+        test = LabeledData.from_rows(
+            csv_data_loader(conf.test_location), one_indexed=True
+        )
     # The reference hardcodes mnistImageSize=784 (:24); inferring the width
     # from the data keeps flag parity while admitting any pixel count
     # (e.g. the 64-pixel sklearn digits used for real-data accuracy runs).
     conf.mnist_image_size = train.data.shape[1]
-    return run(conf, train, test, mesh=parse_mesh(a.mesh))
+    try:
+        return run(conf, train, test, mesh=parse_mesh(a.mesh))
+    finally:
+        if a.trace:
+            trace.flush()
 
 
 if __name__ == "__main__":

@@ -19,9 +19,10 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import trace
 from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
 from ..core.ingest import stream_batches
-from ..core.logging import Logging, configure_logging
+from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
 from ..core.resilience import assert_all_finite
 from ..evaluation.map import MeanAveragePrecisionEvaluator
@@ -194,40 +195,52 @@ def run(
         # Part 1+2: SIFT descriptors per shape bucket (reference :36-57).
         # Runs BEFORE the label node: a streaming source only knows its
         # image order (and therefore labels) after the descriptor pass.
-        train_desc = extract_sift_buckets(conf, train.images, mesh)
+        with stage_timer("sift"):
+            train_desc = extract_sift_buckets(conf, train.images, mesh)
 
         label_node = ClassLabelIndicatorsFromIntArrayLabels(VOC_NUM_CLASSES)
         train_labels = label_node(train.labels)
 
         # Part 1a: PCA — fit on sampled descriptor columns, or load (:40-50)
-        if conf.pca_file is not None:
-            pca_mat = jnp.asarray(
-                np.loadtxt(conf.pca_file, delimiter=",", ndmin=2).T, jnp.float32
-            )
-        else:
-            samples = sample_columns(train_desc, conf.num_pca_samples, conf.seed)
-            pca_mat = compute_pca(samples.T, conf.desc_dim)
-        batch_pca = BatchPCATransformer(pca_mat)
+        with stage_timer("pca"):
+            if conf.pca_file is not None:
+                pca_mat = jnp.asarray(
+                    np.loadtxt(conf.pca_file, delimiter=",", ndmin=2).T,
+                    jnp.float32,
+                )
+            else:
+                samples = sample_columns(
+                    train_desc, conf.num_pca_samples, conf.seed
+                )
+                pca_mat = compute_pca(samples.T, conf.desc_dim)
+            batch_pca = BatchPCATransformer(pca_mat)
 
-        pca_desc = {
-            shape: (idx, batch_pca(descs)) for shape, (idx, descs) in train_desc.items()
-        }
+            pca_desc = {
+                shape: (idx, batch_pca(descs))
+                for shape, (idx, descs) in train_desc.items()
+            }
 
         # Part 2a: GMM — fit on sampled PCA'd columns, or load (:59-70)
-        if conf.gmm_mean_file is not None:
-            gmm = GaussianMixtureModel.load(
-                conf.gmm_mean_file, conf.gmm_var_file, conf.gmm_wts_file
-            )
-        else:
-            gmm_samples = sample_columns(pca_desc, conf.num_gmm_samples, conf.seed + 1)
-            gmm = GaussianMixtureModelEstimator(conf.vocab_size).fit(gmm_samples.T)
-        assert_all_finite(gmm, "VOC GMM fit")
+        with stage_timer("gmm"):
+            if conf.gmm_mean_file is not None:
+                gmm = GaussianMixtureModel.load(
+                    conf.gmm_mean_file, conf.gmm_var_file, conf.gmm_wts_file
+                )
+            else:
+                gmm_samples = sample_columns(
+                    pca_desc, conf.num_gmm_samples, conf.seed + 1
+                )
+                gmm = GaussianMixtureModelEstimator(conf.vocab_size).fit(
+                    gmm_samples.T
+                )
+            assert_all_finite(gmm, "VOC GMM fit")
 
         # Part 3: Fisher features (:72-82)
-        fisher = fisher_feature_pipeline(gmm)
-        train_features = jnp.asarray(
-            scatter_features(pca_desc, fisher, len(train), feat_dim)
-        )
+        with stage_timer("fisher_features"):
+            fisher = fisher_feature_pipeline(gmm)
+            train_features = jnp.asarray(
+                scatter_features(pca_desc, fisher, len(train), feat_dim)
+            )
 
         # Part 4: linear model (:84-86) — mesh-distributed when given one;
         # with a solve checkpoint the BCD fit persists per-block state and
@@ -241,12 +254,14 @@ def run(
             state_path = bcd_checkpoint_path(conf.solve_checkpoint)
             if os.path.exists(state_path):
                 solve_kwargs["resume_from"] = conf.solve_checkpoint
-        solver = BlockLeastSquaresEstimator(4096, 1, conf.lam, mesh=mesh)
-        model = solver.fit(
-            train_features, train_labels, num_features=feat_dim, **solve_kwargs
-        )
-        log_fit_report(solver, label="VOC SIFT-Fisher solve")
-        assert_all_finite(model, "VOC block least-squares fit")
+        with stage_timer("solve"):
+            solver = BlockLeastSquaresEstimator(4096, 1, conf.lam, mesh=mesh)
+            model = solver.fit(
+                train_features, train_labels, num_features=feat_dim,
+                **solve_kwargs,
+            )
+            log_fit_report(solver, label="VOC SIFT-Fisher solve")
+            assert_all_finite(model, "VOC block least-squares fit")
         if state_path is not None and os.path.exists(state_path):
             # The per-block state is a RESUME artifact, not a model cache:
             # leaving the completed state behind would make a later rerun
@@ -261,12 +276,13 @@ def run(
             log.log_info("saved fitted pipeline to %s", conf.pipeline_file)
 
     # Test path (:92-106)
-    test_desc = extract_sift_buckets(conf, test.images, mesh)
-    test_features = scatter_features(
-        test_desc, lambda d: fisher(batch_pca(d)), len(test), feat_dim
-    )
+    with stage_timer("eval"):
+        test_desc = extract_sift_buckets(conf, test.images, mesh)
+        test_features = scatter_features(
+            test_desc, lambda d: fisher(batch_pca(d)), len(test), feat_dim
+        )
 
-    predictions = np.asarray(model(jnp.asarray(test_features)))
+        predictions = np.asarray(model(jnp.asarray(test_features)))
     aps = MeanAveragePrecisionEvaluator(test.labels, predictions, VOC_NUM_CLASSES)
     results = {
         "aps": aps,
@@ -320,7 +336,16 @@ def main(argv=None):
         default=None,
         help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
     )
+    p.add_argument(
+        "--trace",
+        default=None,
+        metavar="PATH",
+        help="write a Chrome-trace JSON (Perfetto-loadable; .jsonl for the "
+        "JSONL event log) of the run — the KEYSTONE_TRACE env equivalent",
+    )
     a = p.parse_args(argv)
+    if a.trace:
+        trace.enable(a.trace)
     conf = SIFTFisherConfig(
         train_location=a.trainLocation,
         test_location=a.testLocation,
@@ -354,7 +379,11 @@ def main(argv=None):
         )
     else:
         test = voc_loader(conf.test_location, conf.label_path)
-    return run(conf, train, test, mesh=parse_mesh(a.mesh))
+    try:
+        return run(conf, train, test, mesh=parse_mesh(a.mesh))
+    finally:
+        if a.trace:
+            trace.flush()
 
 
 if __name__ == "__main__":

@@ -56,6 +56,7 @@ import faults
 from keystone_tpu.core import checkpoint as ckpt_mod
 from keystone_tpu.core import ingest
 from keystone_tpu.core import memory as kmem
+from keystone_tpu.core import trace
 from keystone_tpu.core.resilience import (
     DeadlineExceeded,
     counters,
@@ -130,6 +131,10 @@ class ChaosResult:
     phase: str | None = None
     counters_delta: dict = dataclasses.field(default_factory=dict)
     seconds: float = 0.0
+    #: where this schedule's trace landed (run_schedule(trace_path=)) —
+    #: the ONE place the per-schedule filename lives; verifiers read it
+    #: from here instead of re-deriving the naming convention.
+    trace_path: str | None = None
 
     def ok(self) -> bool:
         return self.outcome in ("completed_equal", "typed_error")
@@ -145,6 +150,7 @@ class ChaosResult:
             "phase": self.phase,
             "counters_delta": dict(self.counters_delta),
             "seconds": round(self.seconds, 3),
+            "trace_path": self.trace_path,
         }
 
 
@@ -584,8 +590,18 @@ def expected_outcome(fault: Fault) -> str:
     return "completed_equal"
 
 
-def run_schedule(seed: int, workload: str = "mnist", tmpdir: str | None = None) -> ChaosResult:
-    """Run ONE seeded fault schedule end-to-end and judge the outcome."""
+def run_schedule(
+    seed: int,
+    workload: str = "mnist",
+    tmpdir: str | None = None,
+    trace_path: str | None = None,
+) -> ChaosResult:
+    """Run ONE seeded fault schedule end-to-end and judge the outcome.
+
+    ``trace_path``: write a per-schedule Chrome-trace JSON of the faulted
+    run — every counted fault lands in it as an instant event (kind attr)
+    and every failed span carries the error type, so
+    :func:`verify_trace` can hold the trace to the never-silent bar."""
     fault = make_schedule(seed)
     own_tmp = tmpdir is None
     if own_tmp:
@@ -594,56 +610,135 @@ def run_schedule(seed: int, workload: str = "mnist", tmpdir: str | None = None) 
     result = ChaosResult(seed=seed, workload=workload, fault=fault, outcome="")
     with _clean_env():
         base = baseline(workload)
-        before = counters.counts()
+        if trace_path is not None:
+            # Per-schedule timeline: clear the buffer so this trace holds
+            # exactly this schedule's events (baseline is pre-cached above).
+            trace.reset()
+            trace.enable(trace_path)
+        before = counters.snapshot()
         try:
-            res = _run_faulted(fault, workload, tmpdir, seed)
-        except TYPED_ERRORS as e:
-            result.outcome = "typed_error"
-            result.error_type = type(e).__name__
-            result.error = str(e)
-            result.phase = getattr(e, "phase", None)
-        except ChaosOracleError as e:
-            result.outcome = "ORACLE_FAILED"
-            result.error_type = type(e).__name__
-            result.error = str(e)
-        except Exception as e:  # noqa: BLE001 — the contract violation case
-            result.outcome = "UNTYPED_ERROR"
-            result.error_type = type(e).__name__
-            result.error = str(e)
-        else:
-            got = res.get("test_predictions")
-            want = base.get("test_predictions")
-            if got is None or want is None:
-                # A missing prediction vector must never score as equal —
-                # that would be the oracle passing vacuously.
-                result.outcome = "ORACLE_FAILED"
-                result.error = (
-                    "no test_predictions to compare "
-                    f"(faulted: {got is not None}, baseline: {want is not None})"
-                )
-            elif _preds_equal(got, want):
-                result.outcome = "completed_equal"
-            else:
-                result.outcome = "SILENT_WRONG_MODEL"
-                result.error = (
-                    "run completed but predictions differ from the "
-                    "fault-free baseline"
-                )
-        after = counters.counts()
-        result.counters_delta = {
-            k: after[k] - before.get(k, 0)
-            for k in after
-            if after[k] != before.get(k, 0)
-        }
+            result.outcome = _judge_schedule(
+                result, fault, workload, tmpdir, seed, base
+            )
+        finally:
+            after = counters.snapshot()
+            result.counters_delta = {
+                k: after[k] - before.get(k, 0)
+                for k in after
+                if after[k] != before.get(k, 0)
+            }
+            if trace_path is not None:
+                # finally: even an unexpected (KeyboardInterrupt-class)
+                # escape must not leave tracing globally enabled with
+                # _path aimed at this schedule's file.
+                trace.flush(trace_path)
+                trace.disable()
+                result.trace_path = trace_path
     result.seconds = time.monotonic() - t0
     if own_tmp:
         shutil.rmtree(tmpdir, ignore_errors=True)
     return result
 
 
-def run_suite(seeds, workload: str = "mnist") -> list[ChaosResult]:
+def _judge_schedule(result, fault, workload, tmpdir, seed, base) -> str:
+    """Run one faulted schedule and return the judged outcome (filling
+    ``result``'s error fields as a side effect)."""
+    try:
+        res = _run_faulted(fault, workload, tmpdir, seed)
+    except TYPED_ERRORS as e:
+        result.error_type = type(e).__name__
+        result.error = str(e)
+        result.phase = getattr(e, "phase", None)
+        return "typed_error"
+    except ChaosOracleError as e:
+        result.error_type = type(e).__name__
+        result.error = str(e)
+        return "ORACLE_FAILED"
+    except Exception as e:  # noqa: BLE001 — the contract violation case
+        result.error_type = type(e).__name__
+        result.error = str(e)
+        return "UNTYPED_ERROR"
+    got = res.get("test_predictions")
+    want = base.get("test_predictions")
+    if got is None or want is None:
+        # A missing prediction vector must never score as equal — that
+        # would be the oracle passing vacuously.
+        result.error = (
+            "no test_predictions to compare "
+            f"(faulted: {got is not None}, baseline: {want is not None})"
+        )
+        return "ORACLE_FAILED"
+    if _preds_equal(got, want):
+        return "completed_equal"
+    result.error = (
+        "run completed but predictions differ from the fault-free baseline"
+    )
+    return "SILENT_WRONG_MODEL"
+
+
+def verify_trace(trace_path: str, result: ChaosResult) -> list[str]:
+    """Hold one schedule's trace to the never-silent bar.  Returns the
+    violations (empty = clean):
+
+    * every fault kind counted during the schedule must appear as a
+      ``fault`` instant event with a matching ``kind`` attribute;
+    * a typed-error outcome must also be visible as a span that FAILED
+      with that error type (spans record ``error`` on exception) or as a
+      counted fault event — a typed error that left no trace evidence is
+      an observability regression even when the run itself was judged ok.
+    """
+    import json as _json
+
+    with open(trace_path) as f:
+        if trace_path.endswith(".jsonl"):
+            events = [_json.loads(line) for line in f if line.strip()]
+        else:
+            doc = _json.load(f)
+            events = (
+                doc.get("traceEvents", []) if isinstance(doc, dict) else doc
+            )
+    fault_kinds = {
+        ev.get("args", {}).get("kind")
+        for ev in events
+        if ev.get("ph") == "i" and ev.get("name") == "fault"
+    }
+    span_errors = {
+        ev.get("args", {}).get("error")
+        for ev in events
+        if ev.get("ph") == "X" and ev.get("args", {}).get("error")
+    }
+    missing = [
+        f"counted fault {kind!r} has no trace event"
+        for kind in sorted(result.counters_delta)
+        if kind not in fault_kinds
+    ]
+    if (
+        result.outcome == "typed_error"
+        and result.error_type not in span_errors
+        and not fault_kinds
+    ):
+        missing.append(
+            f"typed error {result.error_type} appears in no span and no "
+            "fault event — a silent typed failure"
+        )
+    return missing
+
+
+def run_suite(
+    seeds, workload: str = "mnist", trace_dir: str | None = None
+) -> list[ChaosResult]:
     tmpdir = tempfile.mkdtemp(prefix="chaos_suite_")
     try:
-        return [run_schedule(s, workload=workload, tmpdir=tmpdir) for s in seeds]
+        results = []
+        for s in seeds:
+            tp = (
+                os.path.join(trace_dir, f"chaos_seed{s}.json")
+                if trace_dir is not None
+                else None
+            )
+            results.append(
+                run_schedule(s, workload=workload, tmpdir=tmpdir, trace_path=tp)
+            )
+        return results
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)

@@ -1,0 +1,577 @@
+"""Unified tracing & metrics (core.trace) — the ISSUE 5 acceptance set:
+
+* span nesting/threading correctness (depth/parents never cross threads);
+* disabled-mode overhead guard: no retained allocation growth;
+* Chrome trace_event (Perfetto) JSON schema validation + JSONL export;
+* ``Pipeline.profile`` per-node bytes/dtype/shape on a 3-node pipeline;
+* streaming-ingest overlap efficiency recomputed from span intervals
+  matches the bench ``e2e`` methodology within 5%;
+* solver ladder tier spans with the FitReport linked in;
+* ``resilience.counters`` atomic ``snapshot(reset=)`` (no read/reset race)
+  and fault instants in the trace (chaos ``--trace`` invariant);
+* ``stage_timer`` back-compat (same log line, now also a span) and the
+  ``KEYSTONE_LOG_LEVEL`` env knob.
+"""
+
+import gc
+import io
+import json
+import logging
+import os
+import sys
+import tarfile
+import threading
+import time
+import tracemalloc
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.core import ingest, trace
+from keystone_tpu.core.logging import configure_logging, stage_timer
+from keystone_tpu.core.pipeline import FunctionTransformer, Pipeline
+from keystone_tpu.core.resilience import FaultCounters, counters
+from keystone_tpu.loaders import image_loaders
+from keystone_tpu.solvers.block import BlockLeastSquaresEstimator
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import trace_view  # noqa: E402  (tools/trace_view.py)
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    """Every test starts and ends with tracing off and the buffer empty —
+    the module is process-global state."""
+    trace.disable()
+    trace.reset()
+    yield
+    trace.disable()
+    trace.reset()
+
+
+def _trace_to(tmp_path, name="t.json"):
+    path = str(tmp_path / name)
+    trace.enable(path)
+    return path
+
+
+def _spans_by_name(events):
+    out = {}
+    for ev in events:
+        if ev.get("ph") == "X":
+            out.setdefault(ev["name"], []).append(ev)
+    return out
+
+
+# -- span nesting / threading -------------------------------------------------
+
+
+def test_span_nesting_depth_and_parent(tmp_path):
+    path = _trace_to(tmp_path)
+    with trace.span("outer"):
+        with trace.span("inner"):
+            pass
+    with trace.span("sibling"):
+        pass
+    trace.flush(path)
+    spans = _spans_by_name(trace_view.load_events(path))
+    outer, inner, sib = spans["outer"][0], spans["inner"][0], spans["sibling"][0]
+    assert outer["args"]["depth"] == 0 and "parent" not in outer["args"]
+    assert inner["args"]["depth"] == 1 and inner["args"]["parent"] == "outer"
+    assert sib["args"]["depth"] == 0
+    # time containment: the child interval sits inside the parent's
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+
+def test_span_threads_have_independent_stacks(tmp_path):
+    path = _trace_to(tmp_path)
+    barrier = threading.Barrier(2)
+
+    def worker(tag):
+        barrier.wait()
+        with trace.span(f"{tag}_outer"):
+            time.sleep(0.01)
+            with trace.span(f"{tag}_inner"):
+                time.sleep(0.01)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,), name=f"w-{t}")
+        for t in ("a", "b")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    trace.flush(path)
+    spans = _spans_by_name(trace_view.load_events(path))
+    for tag in ("a", "b"):
+        inner = spans[f"{tag}_inner"][0]
+        # nesting resolves within the thread, never across: a_inner's
+        # parent is a_outer even though b_outer was open concurrently
+        assert inner["args"]["parent"] == f"{tag}_outer"
+        assert inner["args"]["depth"] == 1
+        assert inner["tid"] == spans[f"{tag}_outer"][0]["tid"]
+    assert spans["a_outer"][0]["tid"] != spans["b_outer"][0]["tid"]
+
+
+def test_generator_hosted_span_abort_is_not_an_error(tmp_path):
+    # ingest.consume spans live across a generator yield: a consumer that
+    # stops early (or raises OUTSIDE the generator frame) delivers
+    # GeneratorExit at the yield — that is an abort, not the pipeline's
+    # failure, and must never masquerade as the span's error type.
+    path = _trace_to(tmp_path)
+
+    def gen():
+        with trace.span("hosted"):
+            yield 1
+
+    g = gen()
+    next(g)
+    g.close()  # delivers GeneratorExit at the yield point
+    trace.flush(path)
+    args = _spans_by_name(trace_view.load_events(path))["hosted"][0]["args"]
+    assert args.get("aborted") is True
+    assert "error" not in args
+
+
+def test_span_error_attribute_recorded(tmp_path):
+    path = _trace_to(tmp_path)
+    with pytest.raises(ValueError):
+        with trace.span("doomed"):
+            raise ValueError("boom")
+    trace.flush(path)
+    spans = _spans_by_name(trace_view.load_events(path))
+    assert spans["doomed"][0]["args"]["error"] == "ValueError"
+
+
+# -- disabled-mode overhead ---------------------------------------------------
+
+
+def test_disabled_mode_no_allocation_growth():
+    assert not trace.enabled()
+    for _ in range(100):  # warm any lazy state
+        with trace.span("warm", k=1):
+            pass
+        trace.instant("warm")
+    gc.collect()
+    filters = [tracemalloc.Filter(True, trace.__file__)]
+    tracemalloc.start()
+    try:
+        before = tracemalloc.take_snapshot().filter_traces(filters)
+        for _ in range(5000):
+            with trace.span("hot"):
+                pass
+            trace.instant("hot", n=1)
+        gc.collect()
+        after = tracemalloc.take_snapshot().filter_traces(filters)
+    finally:
+        tracemalloc.stop()
+    size_before = sum(s.size for s in before.statistics("filename"))
+    size_after = sum(s.size for s in after.statistics("filename"))
+    # Disabled spans/instants must RETAIN nothing: no event buffering, no
+    # growth attributable to the trace module (4 KB slack for allocator
+    # bookkeeping noise).
+    assert size_after - size_before < 4096, (
+        f"disabled tracing retained {size_after - size_before} bytes "
+        "across 5000 spans"
+    )
+    assert trace.events() == []
+
+
+# -- exporters ----------------------------------------------------------------
+
+
+def test_perfetto_chrome_trace_schema(tmp_path):
+    path = _trace_to(tmp_path)
+    with trace.span("stage_a", cat="stage", bytes=1024):
+        with trace.span("child"):
+            pass
+    trace.instant("hbm_admission", admitted=True, charged_gb=0.5)
+    counters.record("trace_test_fault", "schema probe")
+    trace.flush(path)
+
+    with open(path) as f:
+        doc = json.load(f)  # must be valid JSON wholesale
+    assert isinstance(doc, dict) and isinstance(doc["traceEvents"], list)
+    assert doc["traceEvents"], "no events exported"
+    phases = set()
+    for ev in doc["traceEvents"]:
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert ev["ph"] in ("X", "i", "M")
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        phases.add(ev["ph"])
+        if ev["ph"] in ("X", "i"):
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+            assert isinstance(ev.get("args", {}), dict)
+        if ev["ph"] == "X":
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        if ev["ph"] == "M":
+            assert ev["name"] == "thread_name"
+    # complete spans, instants, and thread metadata all present
+    assert phases == {"X", "i", "M"}
+    # the fault counter landed as a kind-tagged instant (chaos invariant)
+    kinds = {
+        ev["args"].get("kind")
+        for ev in doc["traceEvents"]
+        if ev["ph"] == "i" and ev["name"] == "fault"
+    }
+    assert "trace_test_fault" in kinds
+
+
+def test_jsonl_export(tmp_path):
+    path = _trace_to(tmp_path, "t.jsonl")
+    with trace.span("a"):
+        pass
+    trace.instant("b")
+    trace.flush(path)
+    with open(path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    names = {ev["name"] for ev in events}
+    assert {"a", "b"} <= names
+
+
+# -- Pipeline.profile ---------------------------------------------------------
+
+
+def test_pipeline_profile_per_node_bytes(tmp_path):
+    path = _trace_to(tmp_path)
+    pipe = Pipeline(
+        [
+            FunctionTransformer(lambda b: b * 2.0, name="double"),
+            FunctionTransformer(
+                lambda b: jnp.concatenate([b, b], axis=1), name="widen"
+            ),
+            FunctionTransformer(lambda b: jnp.sum(b, axis=1), name="reduce"),
+        ]
+    )
+    batch = jnp.ones((4, 8), jnp.float32)
+    prof = pipe.profile(batch)
+    trace.flush(path)
+
+    assert [n.name for n in prof.nodes] == ["double", "widen", "reduce"]
+    assert [n.output_bytes for n in prof.nodes] == [
+        4 * 8 * 4,  # [4, 8] f32
+        4 * 16 * 4,  # [4, 16] f32
+        4 * 4,  # [4] f32
+    ]
+    assert [n.shape for n in prof.nodes] == [(4, 8), (4, 16), (4,)]
+    assert all(n.dtype == "float32" for n in prof.nodes)
+    assert all(n.seconds >= 0 for n in prof.nodes)
+    assert prof.total_seconds >= sum(n.seconds for n in prof.nodes) * 0.5
+    assert prof.input_bytes == 4 * 8 * 4
+    np.testing.assert_allclose(np.asarray(prof.output), np.full(4, 32.0))
+    json.dumps(prof.record())  # JSON-able for bench artifacts
+    assert "double" in prof.summary()
+
+    # the profile is also a span tree in the trace
+    spans = _spans_by_name(trace_view.load_events(path))
+    assert "pipeline.profile" in spans
+    node_span = spans["node:widen"][0]
+    assert node_span["args"]["parent"] == "pipeline.profile"
+    assert node_span["args"]["output_bytes"] == 4 * 16 * 4
+
+
+# -- solver ladder spans ------------------------------------------------------
+
+
+def test_block_solve_emits_tier_spans_with_report(tmp_path, rng):
+    path = _trace_to(tmp_path)
+    x = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
+    y = jnp.asarray(
+        2.0 * np.eye(4)[rng.integers(0, 4, 64)] - 1.0, jnp.float32
+    )
+    est = BlockLeastSquaresEstimator(16, num_iter=1, lam=1e-2)
+    est.fit(x, y)
+    trace.flush(path)
+    events = trace_view.load_events(path)
+    spans = _spans_by_name(events)
+    solve = spans["solve:bcd_fit"][0]
+    # FitReport linked into the solve span
+    assert solve["args"]["report"]["chosen_tier"] == est.last_fit_report.chosen
+    tier = spans[f"tier:{est.last_fit_report.chosen}"][0]
+    assert tier["args"]["parent"] == "solve:bcd_fit"
+    assert tier["args"]["solve"] == "bcd_fit"
+    # every admission decision is an instant on the same timeline
+    admissions = [
+        ev
+        for ev in events
+        if ev.get("ph") == "i" and ev["name"] == "hbm_admission"
+    ]
+    assert admissions and all(
+        "admitted" in ev["args"] and "reason" in ev["args"]
+        for ev in admissions
+    )
+
+
+def test_forced_degradation_denials_visible_in_trace(tmp_path, rng, monkeypatch):
+    # A pinched budget denies the fused tier: the denial must be visible
+    # as a non-admitted hbm_admission instant AND the chosen degraded tier
+    # as a span — the trace tells the whole ladder story.
+    monkeypatch.setenv("KEYSTONE_HBM_BUDGET", "10K")
+    path = _trace_to(tmp_path)
+    x = rng.normal(size=(128, 64)).astype(np.float32)
+    y = (2.0 * np.eye(4)[rng.integers(0, 4, 128)] - 1.0).astype(np.float32)
+    est = BlockLeastSquaresEstimator(32, num_iter=1, lam=1e-2)
+    est.fit(x, y)
+    trace.flush(path)
+    assert est.last_fit_report.denials  # the budget actually bit
+    events = trace_view.load_events(path)
+    denied = [
+        ev
+        for ev in events
+        if ev.get("ph") == "i"
+        and ev["name"] == "hbm_admission"
+        and not ev["args"]["admitted"]
+    ]
+    assert denied
+    spans = _spans_by_name(events)
+    assert f"tier:{est.last_fit_report.chosen}" in spans
+
+
+# -- ingest spans & overlap ---------------------------------------------------
+
+
+def _sleepy_tar(tmp_path, n):
+    """Tar whose members are placeholder bytes — decode is patched."""
+    path = str(tmp_path / "sleepy.tar")
+    with tarfile.open(path, "w") as tf:
+        for i in range(n):
+            data = b"x" * 64
+            info = tarfile.TarInfo(f"img_{i:03d}.jpg")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return path
+
+
+def test_ingest_overlap_from_spans_matches_bench_methodology(
+    tmp_path, monkeypatch
+):
+    """The bench ``e2e`` overlap efficiency = e2e_rate / min(decode_rate,
+    featurize_rate), measured from three passes.  The trace recomputation
+    (``max(decode_busy, consume_busy) / wall`` over span intervals of the
+    ONE e2e pass) must land within 5% of it.  Decode/featurize costs are
+    pinned by sleeps so the comparison is about the span plumbing, not
+    scheduler noise — decode-bound, the realistic streaming regime."""
+    # Sleep scale chosen so scheduler jitter (~10-20 ms per pass on a
+    # loaded CPU host) stays well inside the 5% band: the decode pass is
+    # ~0.7 s, so 5% is ~35 ms of headroom.
+    n_images, batch = 24, 4
+    decode_s, feat_s = 0.03, 0.015  # per image / per batch
+    img = np.zeros((40, 40, 3), np.float32)
+
+    def slow_decode(data):
+        time.sleep(decode_s)
+        return img
+
+    monkeypatch.setattr(image_loaders, "decode_image", slow_decode)
+    tar = _sleepy_tar(tmp_path, n_images)
+    kw = dict(num_threads=1, decode_ahead_slots=2, transfer=False)
+
+    # pass 1: decode-only ceiling (bench's decode_images_per_sec)
+    t0 = time.perf_counter()
+    with ingest.stream_batches(tar, batch, **kw) as st:
+        chunks = [b.host for b in st]
+    t_decode = time.perf_counter() - t0
+    assert st.join(10.0)
+    assert sum(c.shape[0] for c in chunks) == n_images
+
+    # pass 2: featurize-only ceiling (bench's featurize_images_per_sec)
+    t0 = time.perf_counter()
+    for _ in chunks:
+        time.sleep(feat_s)
+    t_feat = time.perf_counter() - t0
+
+    # pass 3: the overlapped e2e pipeline, traced
+    path = _trace_to(tmp_path)
+    t0 = time.perf_counter()
+    with ingest.stream_batches(tar, batch, **kw) as st:
+        for b in st:
+            time.sleep(feat_s)  # the "featurize" of this chunk
+    t_e2e = time.perf_counter() - t0
+    assert st.join(10.0)
+    trace.flush(path)
+    trace.disable()
+
+    rate_e2e = n_images / t_e2e
+    bench_eff = rate_e2e / min(n_images / t_decode, n_images / t_feat)
+
+    overlap = trace_view.overlap_from_spans(trace_view.load_events(path))
+    assert overlap is not None
+    assert overlap["decode_spans"] == n_images
+    assert overlap["consume_spans"] == -(-n_images // batch)
+    trace_eff = overlap["overlap_efficiency"]
+    assert trace_eff is not None
+    assert abs(trace_eff - bench_eff) <= 0.05 * bench_eff, (
+        f"trace-recomputed overlap {trace_eff} vs bench-methodology "
+        f"{bench_eff:.3f} (decode {t_decode:.3f}s, feat {t_feat:.3f}s, "
+        f"e2e {t_e2e:.3f}s)"
+    )
+    # decode-bound stream: overlap should be high by construction
+    assert trace_eff > 0.8
+
+
+def test_ingest_producer_span_records_stats(tmp_path, monkeypatch):
+    img = np.zeros((40, 40, 3), np.float32)
+    monkeypatch.setattr(image_loaders, "decode_image", lambda data: img)
+    tar = _sleepy_tar(tmp_path, 6)
+    path = _trace_to(tmp_path)
+    with ingest.stream_batches(tar, 2, num_threads=1, transfer=False) as st:
+        list(st)
+    assert st.join(10.0)
+    trace.flush(path)
+    spans = _spans_by_name(trace_view.load_events(path))
+    prod = spans["ingest.produce"][0]
+    assert prod["args"]["decoded"] == 6
+    assert prod["args"]["batches"] == 3
+    assert "ingest.ring_put" in spans and "ingest.ring_get" in spans
+
+
+# -- metrics registry ---------------------------------------------------------
+
+
+def test_metrics_registry_counters_gauges_histograms():
+    m = trace.Metrics()
+    assert m.inc("requests") == 1
+    assert m.inc("requests", 4) == 5
+    m.gauge("ring_depth", 3.0)
+    for v in range(100):
+        m.observe("latency_ms", float(v))
+    snap = m.snapshot()
+    assert snap["counters"] == {"requests": 5}
+    assert snap["gauges"] == {"ring_depth": 3.0}
+    h = snap["histograms"]["latency_ms"]
+    assert h["count"] == 100 and h["min"] == 0.0 and h["max"] == 99.0
+    assert 45.0 <= h["mean"] <= 55.0
+    assert 45.0 <= h["p50"] <= 55.0 and h["p90"] >= h["p50"]
+    json.dumps(snap)  # bench embeds this verbatim
+
+    # snapshot(reset=True) clears atomically
+    snap2 = m.snapshot(reset=True)
+    assert snap2["counters"] == {"requests": 5}
+    assert m.snapshot()["counters"] == {}
+
+
+def test_metrics_snapshot_includes_fault_group():
+    before = trace.metrics.snapshot()["faults"].get("trace_group_probe", 0)
+    counters.record("trace_group_probe")
+    snap = trace.metrics.snapshot()
+    assert snap["faults"]["trace_group_probe"] == before + 1
+    # the registry snapshot is what bench.py embeds — must be JSON-able
+    json.dumps(snap)
+
+
+def test_fault_counters_snapshot_reset_is_atomic():
+    fc = FaultCounters()
+    quiet = logging.getLogger("keystone_tpu.resilience")
+    prev = quiet.level
+    quiet.setLevel(logging.CRITICAL)
+    try:
+        stop = threading.Event()
+        produced = {"n": 0}
+
+        def hammer():
+            while not stop.is_set():
+                fc.record("hammered")
+                produced["n"] += 1
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        collected = 0
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            collected += fc.snapshot(reset=True).get("hammered", 0)
+        stop.set()
+        for t in threads:
+            t.join()
+        collected += fc.snapshot(reset=True).get("hammered", 0)
+    finally:
+        quiet.setLevel(prev)
+    # atomic snapshot+reset: every record lands in exactly one snapshot
+    assert collected == produced["n"]
+    assert fc.counts() == {}
+
+
+# -- stage_timer & log level --------------------------------------------------
+
+
+def test_stage_timer_same_log_line_and_span(tmp_path, caplog):
+    path = _trace_to(tmp_path)
+    with caplog.at_level(logging.INFO, logger="keystone_tpu"):
+        with stage_timer("probe_stage"):
+            pass
+    assert any(
+        "probe_stage took" in rec.getMessage() and rec.getMessage().endswith(" s")
+        for rec in caplog.records
+    )
+    trace.flush(path)
+    spans = _spans_by_name(trace_view.load_events(path))
+    assert spans["probe_stage"][0]["cat"] == "stage"
+
+
+def test_keystone_log_level_env(monkeypatch):
+    root = logging.getLogger("keystone_tpu")
+    prev = root.level
+    try:
+        monkeypatch.setenv("KEYSTONE_LOG_LEVEL", "DEBUG")
+        configure_logging()
+        assert root.level == logging.DEBUG
+        monkeypatch.setenv("KEYSTONE_LOG_LEVEL", "warning")  # case-insensitive
+        configure_logging()
+        assert root.level == logging.WARNING
+        monkeypatch.setenv("KEYSTONE_LOG_LEVEL", "15")  # numeric form
+        configure_logging()
+        assert root.level == 15
+        # an explicit level always wins over the env
+        configure_logging(logging.ERROR)
+        assert root.level == logging.ERROR
+        monkeypatch.setenv("KEYSTONE_LOG_LEVEL", "NOT_A_LEVEL")
+        with pytest.raises(ValueError):
+            configure_logging()
+    finally:
+        root.setLevel(prev)
+
+
+# -- chaos --trace ------------------------------------------------------------
+
+
+def test_chaos_schedule_trace_holds_never_silent_bar(tmp_path):
+    import chaos
+
+    # seed 4 -> nan_input: a typed FloatingPointError with a counted
+    # nonfinite_model fault — both must be visible in the trace.
+    path = str(tmp_path / "chaos_seed4.json")
+    r = chaos.run_schedule(4, workload="mnist", trace_path=path)
+    assert r.outcome == "typed_error"
+    assert r.error_type == "FloatingPointError"
+    assert chaos.verify_trace(path, r) == []
+    # and the trace itself names the failure on a span
+    events = trace_view.load_events(path)
+    assert any(
+        ev.get("args", {}).get("error") == "FloatingPointError"
+        for ev in events
+        if ev.get("ph") == "X"
+    )
+
+
+# -- trace_view CLI -----------------------------------------------------------
+
+
+def test_trace_view_summarizes(tmp_path, capsys):
+    path = _trace_to(tmp_path)
+    with trace.span("stage_one", cat="stage"):
+        time.sleep(0.01)
+    with trace.span("stage_two", cat="stage"):
+        pass
+    counters.record("view_probe_fault")
+    trace.flush(path)
+    assert trace_view.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "per-stage totals" in out
+    assert "stage_one" in out and "stage_two" in out
+    assert "view_probe_fault" in out
+    assert "top 10 spans" in out

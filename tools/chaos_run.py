@@ -14,6 +14,15 @@ Usage:
     python tools/chaos_run.py --full                # the full seed set
     python tools/chaos_run.py --workload cifar      # RandomPatchCifar
     python tools/chaos_run.py --stream              # streaming-ingest families
+    python tools/chaos_run.py --trace DIR           # one trace per schedule
+
+``--trace DIR`` writes a Chrome-trace JSON per schedule (Perfetto-loadable)
+and ADDS an observability invariant to the suite: every injected fault must
+appear in its schedule's trace as a counted ``fault`` instant event with a
+matching ``kind`` attribute, and a typed-error outcome must be visible as a
+failed span carrying the error type — typed-error spans are never silent.
+A schedule whose trace misses either fails the run like any other
+violation.
 
 Exit status is nonzero if ANY schedule violates the invariant.  The first
 stdout line is the machine-readable JSON record (truncation-proof, same
@@ -48,6 +57,14 @@ def main(argv=None) -> int:
         "(stream_corrupt / stream_hang families, core.ingest path)",
     )
     p.add_argument("--workload", default="mnist", choices=("mnist", "cifar"))
+    p.add_argument(
+        "--trace",
+        default=None,
+        metavar="DIR",
+        help="write a Chrome-trace JSON per schedule into DIR and assert "
+        "every injected fault appears in it as a counted event "
+        "(typed-error spans never silent)",
+    )
     a = p.parse_args(argv)
 
     import chaos
@@ -66,11 +83,37 @@ def main(argv=None) -> int:
             print("no streaming schedules in the selected seed set")
             return 1
 
-    results = chaos.run_suite(seeds, workload=a.workload)
+    if a.trace is not None:
+        os.makedirs(a.trace, exist_ok=True)
+        if os.environ.get("KEYSTONE_TRACE", "").strip():
+            # Per-schedule tracing resets the global buffer and retargets
+            # the trace path every schedule — an ambient session trace
+            # cannot coexist with it.
+            print(
+                "# WARNING: --trace overrides KEYSTONE_TRACE: per-schedule "
+                "traces reset the buffer, so the env-configured session "
+                "trace will not be written",
+                file=sys.stderr,
+            )
+    results = chaos.run_suite(seeds, workload=a.workload, trace_dir=a.trace)
+    trace_violations: dict[int, list] = {}
+    if a.trace is not None:
+        for r in results:
+            # r.trace_path is the one source of truth for the filename
+            # (set by run_schedule) — never re-derived here.
+            missing = (
+                chaos.verify_trace(r.trace_path, r)
+                if r.trace_path is not None
+                else ["schedule produced no trace file"]
+            )
+            if missing:
+                trace_violations[r.seed] = missing
     violations = [
         r
         for r in results
-        if not r.ok() or r.outcome != chaos.expected_outcome(r.fault)
+        if not r.ok()
+        or r.outcome != chaos.expected_outcome(r.fault)
+        or r.seed in trace_violations
     ]
     record = {
         "metric": "chaos",
@@ -80,13 +123,30 @@ def main(argv=None) -> int:
         "outcomes": {r.outcome: sum(1 for x in results if x.outcome == r.outcome) for r in results},
         "results": [r.record() for r in results],
     }
+    if a.trace is not None:
+        record["trace"] = {
+            "dir": a.trace,
+            "violations": {
+                str(s): v for s, v in sorted(trace_violations.items())
+            },
+        }
     print(json.dumps(record), flush=True)
     for r in results:
-        flag = "ok " if r.ok() and r.outcome == chaos.expected_outcome(r.fault) else "BAD"
+        bad = (
+            not r.ok()
+            or r.outcome != chaos.expected_outcome(r.fault)
+            or r.seed in trace_violations
+        )
+        flag = "BAD" if bad else "ok "
         print(
             f"# {flag} seed={r.seed} {r.fault.kind}: {r.outcome}"
             + (f" ({r.error_type})" if r.error_type else "")
             + f" [{r.seconds:.2f}s]"
+            + (
+                f" TRACE: {'; '.join(trace_violations[r.seed])}"
+                if r.seed in trace_violations
+                else ""
+            )
         )
     print(f"# chaos: {len(results) - len(violations)}/{len(results)} schedules honored the invariant")
     return 1 if violations else 0

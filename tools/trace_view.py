@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Summarize a keystone trace file (core.trace output).
+
+Reads a Chrome trace_event JSON (``KEYSTONE_TRACE=out.json`` / ``--trace``)
+or a JSONL event log (``*.jsonl``) and prints:
+
+* per-stage totals — spans aggregated by name (count, total/mean/max ms),
+  sorted by total time;
+* the top-k individual spans by duration;
+* instant-event summaries (fault counts by kind, HBM admission decisions);
+* streaming-ingest overlap efficiency recomputed FROM span intervals:
+  ``max(decode_busy, consume_busy) / wall`` over the ``ingest.decode`` /
+  ``ingest.consume`` spans — the same quantity the bench ``e2e`` section
+  derives from three separate rate passes, here read off one timeline
+  (decode busy time is the union of the parallel decode lanes' intervals).
+
+Usage:
+    python tools/trace_view.py /tmp/t.json [--top 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(path: str) -> list:
+    """Events from a Chrome trace_event JSON or a JSONL event log."""
+    with open(path) as f:
+        if path.endswith(".jsonl"):
+            return [json.loads(line) for line in f if line.strip()]
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        return doc.get("traceEvents", [])
+    return doc  # bare event array — also valid Chrome format
+
+
+def spans(events: list) -> list:
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def instants(events: list) -> list:
+    return [e for e in events if e.get("ph") == "i"]
+
+
+def per_stage(events: list) -> dict:
+    """name -> {count, total_ms, mean_ms, max_ms}, insertion = total desc."""
+    agg: dict = defaultdict(lambda: {"count": 0, "total_us": 0.0, "max_us": 0.0})
+    for ev in spans(events):
+        a = agg[ev["name"]]
+        a["count"] += 1
+        a["total_us"] += float(ev.get("dur", 0.0))
+        a["max_us"] = max(a["max_us"], float(ev.get("dur", 0.0)))
+    out = {}
+    for name, a in sorted(
+        agg.items(), key=lambda kv: kv[1]["total_us"], reverse=True
+    ):
+        out[name] = {
+            "count": a["count"],
+            "total_ms": round(a["total_us"] / 1e3, 3),
+            "mean_ms": round(a["total_us"] / a["count"] / 1e3, 3),
+            "max_ms": round(a["max_us"] / 1e3, 3),
+        }
+    return out
+
+
+def top_spans(events: list, k: int = 10) -> list:
+    return sorted(
+        spans(events), key=lambda e: float(e.get("dur", 0.0)), reverse=True
+    )[:k]
+
+
+def _union_us(intervals: list) -> float:
+    """Total covered microseconds of possibly-overlapping [t0, t1) spans —
+    parallel decode lanes count wall coverage once, not per thread."""
+    total = 0.0
+    end = float("-inf")
+    for t0, t1 in sorted(intervals):
+        if t1 <= end:
+            continue
+        total += t1 - max(t0, end)
+        end = t1
+    return total
+
+
+def overlap_from_spans(events: list) -> dict | None:
+    """Streaming-ingest overlap efficiency recomputed from one timeline.
+
+    ``decode_busy`` = union of ``ingest.decode`` span intervals (the wall
+    time during which at least one decoder thread was decoding — the
+    producer-side ceiling); ``consume_busy`` = union of ``ingest.consume``
+    spans (the consumer's featurize time); ``wall`` spans first ingest
+    event to last.  A perfectly overlapped pipeline has
+    ``wall ≈ max(decode_busy, consume_busy)``, so
+
+        overlap_efficiency = max(decode_busy, consume_busy) / wall
+
+    — the span-interval form of the bench's ``e2e / min(decode_rate,
+    featurize_rate)``.  Returns None when the trace has no ingest spans.
+    """
+    decode, consume, all_ingest = [], [], []
+    for ev in spans(events):
+        iv = (float(ev["ts"]), float(ev["ts"]) + float(ev.get("dur", 0.0)))
+        if ev["name"] == "ingest.decode":
+            decode.append(iv)
+        elif ev["name"] == "ingest.consume":
+            consume.append(iv)
+        if ev.get("cat") == "ingest":
+            all_ingest.append(iv)
+    if not decode or not consume:
+        return None
+    wall = max(t1 for _, t1 in all_ingest) - min(t0 for t0, _ in all_ingest)
+    decode_busy = _union_us(decode)
+    consume_busy = _union_us(consume)
+    return {
+        "decode_busy_ms": round(decode_busy / 1e3, 3),
+        "consume_busy_ms": round(consume_busy / 1e3, 3),
+        "wall_ms": round(wall / 1e3, 3),
+        "overlap_efficiency": round(
+            max(decode_busy, consume_busy) / wall, 3
+        ) if wall > 0 else None,
+        "decode_spans": len(decode),
+        "consume_spans": len(consume),
+    }
+
+
+def instant_summary(events: list) -> dict:
+    """Counts of instant events: faults by kind, admissions by verdict."""
+    out: dict = {"faults": defaultdict(int), "hbm_admission": defaultdict(int)}
+    for ev in instants(events):
+        args = ev.get("args", {})
+        if ev["name"] == "fault":
+            out["faults"][args.get("kind", "?")] += 1
+        elif ev["name"] == "hbm_admission":
+            key = "admitted" if args.get("admitted") else "denied"
+            out["hbm_admission"][key] += 1
+    return {k: dict(v) for k, v in out.items() if v}
+
+
+def summarize(path: str, top: int = 10) -> str:
+    events = load_events(path)
+    lines = [f"# {path}: {len(events)} events"]
+
+    stages = per_stage(events)
+    lines.append("")
+    lines.append("## per-stage totals (spans aggregated by name)")
+    lines.append(f"{'name':<40} {'count':>6} {'total_ms':>12} {'mean_ms':>10} {'max_ms':>10}")
+    for name, a in stages.items():
+        lines.append(
+            f"{name:<40} {a['count']:>6} {a['total_ms']:>12.3f} "
+            f"{a['mean_ms']:>10.3f} {a['max_ms']:>10.3f}"
+        )
+
+    lines.append("")
+    lines.append(f"## top {top} spans by duration")
+    for ev in top_spans(events, top):
+        err = ev.get("args", {}).get("error")
+        lines.append(
+            f"{ev['name']:<40} {float(ev.get('dur', 0.0)) / 1e3:>10.3f} ms "
+            f"tid={ev.get('tid')}" + (f" ERROR={err}" if err else "")
+        )
+
+    inst = instant_summary(events)
+    if inst:
+        lines.append("")
+        lines.append("## instants")
+        for group, counts in inst.items():
+            lines.append(f"{group}: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(counts.items())
+            ))
+
+    overlap = overlap_from_spans(events)
+    if overlap is not None:
+        lines.append("")
+        lines.append("## ingest overlap (recomputed from span intervals)")
+        lines.append(
+            f"decode busy {overlap['decode_busy_ms']} ms "
+            f"({overlap['decode_spans']} spans), "
+            f"consume busy {overlap['consume_busy_ms']} ms "
+            f"({overlap['consume_spans']} spans), "
+            f"wall {overlap['wall_ms']} ms -> "
+            f"overlap_efficiency {overlap['overlap_efficiency']}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("trace_view")
+    p.add_argument("path", help="trace file (.json Chrome format or .jsonl)")
+    p.add_argument("--top", type=int, default=10, help="top-k spans to list")
+    a = p.parse_args(argv)
+    print(summarize(a.path, a.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
