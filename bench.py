@@ -182,6 +182,31 @@ def timed_chain_auto(fn, arg, chain_len: int, max_len: int = 2048) -> float:
             chain_len *= 2
 
 
+def _make_jpeg_tar(rng, n_images: int, size: int, labeled: bool = False) -> str:
+    """Temp tar of random ``size``-px JPEGs for the ingest benches (the
+    caller unlinks it).  ``labeled=True`` prefixes members with a 0-9 class
+    directory — the name-borne-label layout the CIFAR stream path reads."""
+    import io
+    import tarfile
+    import tempfile
+
+    from PIL import Image as PILImage
+
+    with tempfile.NamedTemporaryFile(suffix=".tar", delete=False) as tmp:
+        path = tmp.name
+    with tarfile.open(path, "w") as tf:
+        for i in range(n_images):
+            arr = rng.integers(0, 256, (size, size, 3), dtype=np.uint8)
+            buf = io.BytesIO()
+            PILImage.fromarray(arr).save(buf, format="JPEG", quality=90)
+            data = buf.getvalue()
+            name = f"{i % 10}/img_{i:05d}.jpg" if labeled else f"img_{i:05d}.jpg"
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return path
+
+
 def one_hot_pm1(rng, n: int, k: int):
     """+/-1 one-hot label matrix [n, k] — the reference workloads' label
     encoding (ClassLabelIndicators: +1 true class, -1 elsewhere)."""
@@ -925,27 +950,7 @@ def bench_e2e_ingest(rng):
     depth/stall counters come from the stream's own stats.  Images are
     48 px (the loaders' 36 px MIN_DIM floor rules out true-32px CIFAR
     JPEGs) and CIFAR labels ride in the member names."""
-    import io
-    import tarfile
-    import tempfile
-
-    from PIL import Image as PILImage
-
     from keystone_tpu.core.ingest import stream_batches
-
-    def make_tar(n, size):
-        with tempfile.NamedTemporaryFile(suffix=".tar", delete=False) as tmp:
-            path = tmp.name
-        with tarfile.open(path, "w") as tf:
-            for i in range(n):
-                arr = rng.integers(0, 256, (size, size, 3), dtype=np.uint8)
-                buf = io.BytesIO()
-                PILImage.fromarray(arr).save(buf, format="JPEG", quality=90)
-                data = buf.getvalue()
-                info = tarfile.TarInfo(f"{i % 10}/img_{i:05d}.jpg")
-                info.size = len(data)
-                tf.addfile(info, io.BytesIO(data))
-        return path
 
     def rates(tar_path, n_images, batch, feat_fn):
         # decode-only: producer-side ceiling (no H2D, no featurize)
@@ -1002,7 +1007,7 @@ def bench_e2e_ingest(rng):
     from keystone_tpu.workloads.cifar_random_patch import cifar_tar_label
 
     n_cifar, size, batch = 1024, 48, 128
-    tar_path = make_tar(n_cifar, size)
+    tar_path = _make_jpeg_tar(rng, n_cifar, size, labeled=True)
     try:
         conf = RandomCifarConfig(
             num_filters=100, patch_size=6, patch_steps=1, pool_size=14,
@@ -1036,7 +1041,7 @@ def bench_e2e_ingest(rng):
     from keystone_tpu.workloads.fv_common import grayscale
 
     n_fv, size_fv, batch_fv = 96, 256, 16
-    tar_path = make_tar(n_fv, size_fv)
+    tar_path = _make_jpeg_tar(rng, n_fv, size_fv, labeled=True)
     try:
         desc_dim, vocab = 64, 16
         sift = SIFTExtractor(scale_step=1, compute_dtype=jnp.bfloat16)
@@ -1057,34 +1062,148 @@ def bench_e2e_ingest(rng):
     return out
 
 
+def bench_optimizer(rng):
+    """Pipeline-optimizer section (ISSUE 6): the cost-based auto-Cacher on
+    the CIFAR conv >> StandardScaler fit chain, and the closed-loop ingest
+    autotuner on a stall-injected stream.
+
+    * ``auto_cache``: the fit pattern — ``chain.fit(x)`` then one fitted
+      application to the SAME x (the workload usage) — runs the conv
+      featurizer twice uncached and once with the optimizer's memoizing
+      Cacher.  Both walls are measured on the same warmed program; the
+      features must be bit-identical (the memo replays the fit's arrays).
+    * ``autotune``: decode is slowed artificially so the stream starts
+      decode-bound at a deliberately-starved static config; the tuned run
+      starts from the SAME config with the controller on.  Overlap
+      efficiency = e2e rate / the decode-ceiling rate measured at the
+      static config — the tuned run must not be below the static one.
+    """
+    from keystone_tpu.core import optimize
+    from keystone_tpu.core.ingest import StreamConfig, stream_batches
+    from keystone_tpu.core.pipeline import FunctionTransformer
+    from keystone_tpu.loaders import image_loaders
+    from keystone_tpu.ops.stats import StandardScaler
+    from keystone_tpu.workloads.cifar_random_patch import featurize_chunked
+
+    out = {}
+
+    # -- auto-Cacher: cached vs uncached fit wall over the conv chain
+    n, chunk = 2048, 512
+    conf = RandomCifarConfig(
+        num_filters=100, patch_size=6, patch_steps=1, pool_size=14,
+        pool_stride=13, whitener_size=20000, featurize_chunk=chunk,
+    )
+    imgs = rng.uniform(0, 255, (n, 32, 32, 3)).astype(np.float32)
+    filters, whitener = learn_filters(conf, imgs[:512])
+    feat_fn = jax.jit(build_conv_pipeline(conf, filters, whitener).__call__)
+    # Warm the chunk-shaped compile so both timed fits are steady-state.
+    jax.block_until_ready(feat_fn(jnp.zeros((chunk, 32, 32, 3), jnp.float32)))
+
+    def make_chain():
+        return FunctionTransformer(
+            lambda im: featurize_chunked(feat_fn, np.asarray(im), chunk),
+            name="conv_featurize",
+        ).then_estimator(StandardScaler())
+
+    t0 = time.perf_counter()
+    fitted_u = make_chain().fit(imgs)
+    feats_u = jax.block_until_ready(fitted_u(imgs))
+    wall_uncached = time.perf_counter() - t0
+
+    opt_chain, plan = optimize.auto_cache_chain(
+        make_chain(), imgs[:chunk], dataset_rows=n
+    )
+    t0 = time.perf_counter()
+    fitted_c = opt_chain.fit(imgs)
+    feats_c = jax.block_until_ready(fitted_c(imgs))
+    wall_cached = time.perf_counter() - t0
+    bit_identical = bool(
+        np.array_equal(np.asarray(feats_u), np.asarray(feats_c))
+    )
+    optimize.release_caches(fitted_c)
+    out["auto_cache"] = {
+        "images": n,
+        "uncached_fit_wall_seconds": round(wall_uncached, 3),
+        "cached_fit_wall_seconds": round(wall_cached, 3),
+        "speedup": round(wall_uncached / wall_cached, 3),
+        "predictions_bit_identical": bit_identical,
+        "plan": plan.record(),
+    }
+    feats_u = feats_c = fitted_u = fitted_c = None  # noqa: F841 — free HBM
+
+    # -- closed-loop autotuner on a stall-injected stream
+    n_img, size, batch = 192, 48, 16
+    tar_path = _make_jpeg_tar(rng, n_img, size)
+
+    small_feat = jax.jit(lambda x: jnp.mean(x, axis=(1, 2, 3)))
+    real_decode = image_loaders.decode_image
+
+    def stalled_decode(data):
+        time.sleep(0.005)  # the injected stall: decode-bound by fiat
+        return real_decode(data)
+
+    def run_stream(cfg):
+        t0 = time.perf_counter()
+        feats = []
+        with stream_batches(tar_path, batch, config=cfg) as st:
+            for b in st:
+                feats.append((b.indices, np.asarray(small_feat(b.dev()))))
+        secs = time.perf_counter() - t0
+        assert st.join(10.0)
+        return n_img / secs, feats, st
+
+    starved = dict(
+        decode_threads=1, decode_ahead=0, ring_capacity=2,
+        max_decode_threads=8,
+    )
+    image_loaders.decode_image = stalled_decode
+    try:
+        # The decode ceiling AT the static config: no featurize, no H2D.
+        t0 = time.perf_counter()
+        with stream_batches(
+            tar_path, batch, transfer=False, config=StreamConfig(**starved)
+        ) as st:
+            for _ in st:
+                pass
+        decode_rate = n_img / (time.perf_counter() - t0)
+        static_rate, static_feats, _ = run_stream(StreamConfig(**starved))
+        tuned_cfg = StreamConfig(**starved, autotune=True, autotune_interval=2)
+        tuned_rate, tuned_feats, st = run_stream(tuned_cfg)
+    finally:
+        image_loaders.decode_image = real_decode
+        os.unlink(tar_path)
+
+    stream_identical = len(static_feats) == len(tuned_feats) and all(
+        np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+        for a, b in zip(static_feats, tuned_feats)
+    )
+    out["autotune"] = {
+        "images": n_img,
+        "static_images_per_sec": round(static_rate, 2),
+        "tuned_images_per_sec": round(tuned_rate, 2),
+        "speedup": round(tuned_rate / static_rate, 3),
+        # efficiency vs the ceiling of the STATIC config's decode stage —
+        # the tuned run beats 1.0 by widening decode past that config.
+        "static_overlap_efficiency": round(static_rate / decode_rate, 3),
+        "tuned_overlap_efficiency": round(tuned_rate / decode_rate, 3),
+        "output_bit_identical": stream_identical,
+        "tuner": st.tuner.record(),
+    }
+    return out
+
+
 def bench_decode(rng):
     """Host ingest: JPEG-tar decode throughput, serial vs thread-pool
     (reference decodes per-executor in parallel off streamed tars,
     ImageLoaderUtils.scala:60-100).  The speedup is whatever the bench
     host's core budget yields — reported, not assumed."""
-    import io
-    import tarfile
-    import tempfile
-
-    from PIL import Image as PILImage
-
     from keystone_tpu.loaders.image_loaders import (
         _iter_tar_images,
         decode_threads,
     )
 
-    n_images, h, w = 192, 256, 256
-    with tempfile.NamedTemporaryFile(suffix=".tar", delete=False) as tmp:
-        tar_path = tmp.name
-    with tarfile.open(tar_path, "w") as tf:
-        for i in range(n_images):
-            arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
-            buf = io.BytesIO()
-            PILImage.fromarray(arr).save(buf, format="JPEG", quality=90)
-            data = buf.getvalue()
-            info = tarfile.TarInfo(f"img_{i:04d}.jpg")
-            info.size = len(data)
-            tf.addfile(info, io.BytesIO(data))
+    n_images = 192
+    tar_path = _make_jpeg_tar(rng, n_images, 256)
 
     def timed(threads):
         t0 = time.perf_counter()
@@ -1160,6 +1279,7 @@ def main():
     stages = _guarded(bench_stage_ops, rng)
     decode = _guarded(bench_decode, rng)
     e2e = _guarded(bench_e2e_ingest, rng)
+    optimizer = _guarded(bench_optimizer, rng)
     at_scale = _guarded(bench_solve_at_scale, rng)
 
     # ONE atomic registry snapshot feeds both the back-compat "faults" key
@@ -1236,6 +1356,11 @@ def main():
             # per-stream ring depth/stall counters and the overlap
             # efficiency vs its 0.9 target.
             "e2e": e2e,
+            # Pipeline optimizer (core.optimize): auto-Cacher cached-vs-
+            # uncached fit wall + decision table, and the closed-loop
+            # ingest autotuner's knob trajectory + overlap efficiency on a
+            # stall-injected stream.
+            "optimizer": optimizer,
         },
     }
     # Artifact-truncation guard (VERDICT r5 "Driver artifacts"): the driver
@@ -1284,6 +1409,23 @@ def main():
                 f"e2e {r['e2e_images_per_sec']}/s "
                 f"(overlap {r['overlap_efficiency']})"
             )
+    opt = ex["optimizer"]
+    if "error" in opt:
+        print(f"# optimizer: {opt['error'][:120]}")
+    else:
+        ac, at = opt["auto_cache"], opt["autotune"]
+        print(
+            f"# optimizer auto_cache: {ac['uncached_fit_wall_seconds']}s -> "
+            f"{ac['cached_fit_wall_seconds']}s (x{ac['speedup']}, "
+            f"bit_identical {ac['predictions_bit_identical']})"
+        )
+        print(
+            f"# optimizer autotune: {at['static_images_per_sec']}/s -> "
+            f"{at['tuned_images_per_sec']}/s (x{at['speedup']}, "
+            f"{at['tuner']['retunes']} retune(s), overlap "
+            f"{at['static_overlap_efficiency']} -> "
+            f"{at['tuned_overlap_efficiency']})"
+        )
     print(f"# faults: {record['faults'] if record['faults'] else 'none'}")
 
 

@@ -38,6 +38,14 @@ Resilience invariants preserved from the eager loaders:
 * consumer exceptions (or early exit) stop the producer and release the
   decode pool; producer exceptions surface on the consumer's next
   ``__next__``.  ``join()`` lets tests assert every thread exited.
+
+Every sizing knob lives in a mutable :class:`StreamConfig` (env-seeded:
+the ``KEYSTONE_DECODE_THREADS`` / ``KEYSTONE_DECODE_AHEAD`` /
+``KEYSTONE_RING_CAPACITY`` values are INITIAL settings, no longer frozen
+at construction) consulted at every decision point, so the closed-loop
+autotuner (core.optimize.IngestAutotuner, ``KEYSTONE_AUTOTUNE=1``) can
+retune decode width, ring depth, and decode-ahead mid-stream.  Knobs
+change concurrency and buffering only — never ordering or content.
 """
 
 from __future__ import annotations
@@ -89,6 +97,111 @@ def ring_capacity() -> int:
             raise ValueError(f"KEYSTONE_RING_CAPACITY={raw!r} must be >= 1")
         return val
     return DEFAULT_RING_CAPACITY
+
+
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "").strip() in ("1", "true", "on", "yes")
+
+
+def _host_cores() -> int:
+    """Physical decode ceiling: the host's schedulable cores — deliberately
+    NOT ``image_loaders.decode_threads()``, whose env override sets the
+    INITIAL width; capping at the override too would pin the autotuner to
+    it and make widening impossible."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except AttributeError:  # platforms without sched_getaffinity
+        return os.cpu_count() or 1
+
+
+def _env_int(name: str, default: int, minimum: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
+    if val < minimum:
+        raise ValueError(f"{name}={raw!r} must be >= {minimum}")
+    return val
+
+
+@dataclasses.dataclass
+class StreamConfig:
+    """The LIVE knob set of one ingest stream.
+
+    The env knobs (``KEYSTONE_DECODE_THREADS`` / ``KEYSTONE_DECODE_AHEAD`` /
+    ``KEYSTONE_RING_CAPACITY``) used to be read once at stream construction
+    and frozen; they are now only the INITIAL values of this mutable config
+    (:meth:`from_env`).  The stream consults the config at every decision
+    point — each tar member for the decode window, each ring put for the
+    capacity — so mutating a field retunes the stream mid-run.  That is the
+    closed-loop autotuner's mutation surface (core.optimize.IngestAutotuner),
+    and a programmatic configuration API in its own right.
+
+    The knobs control CONCURRENCY AND BUFFERING only — never ordering or
+    content: decodes complete through an in-order FIFO window and chunks
+    assemble identically at any width/depth, so retuning may change speed,
+    never results (the ``autotune_thrash`` chaos family holds it to that).
+
+    ``decode_threads`` is the number of decodes kept in flight (the
+    effective pool width); ``max_decode_threads`` caps how far a tuner may
+    raise it — the thread pool is created at the cap, width is governed by
+    the in-flight window.
+    """
+
+    decode_threads: int
+    decode_ahead: int
+    ring_capacity: int
+    max_decode_threads: int = 0  # 0 -> resolved to >= decode_threads in __post_init__
+    autotune: bool = False  #: create an IngestAutotuner for this stream
+    autotune_interval: int = 4  #: chunks between controller evaluations
+
+    def __post_init__(self):
+        if self.decode_threads < 1:
+            raise ValueError(f"decode_threads must be >= 1, got {self.decode_threads}")
+        if self.decode_ahead < 0:
+            raise ValueError(f"decode_ahead must be >= 0, got {self.decode_ahead}")
+        if self.ring_capacity < 1:
+            raise ValueError(f"ring_capacity must be >= 1, got {self.ring_capacity}")
+        if self.autotune_interval < 1:
+            raise ValueError(
+                f"autotune_interval must be >= 1, got {self.autotune_interval}"
+            )
+        if self.max_decode_threads == 0:
+            self.max_decode_threads = max(self.decode_threads, _host_cores())
+        elif self.max_decode_threads < self.decode_threads:
+            # An EXPLICIT cap below the width is a contradiction, not a
+            # sentinel — silently widening it would let the tuner exceed a
+            # bound the caller set to protect host CPU.
+            raise ValueError(
+                f"max_decode_threads={self.max_decode_threads} is below "
+                f"decode_threads={self.decode_threads}"
+            )
+
+    @classmethod
+    def from_env(cls, **overrides) -> "StreamConfig":
+        """Env-seeded defaults (``KEYSTONE_DECODE_THREADS`` /
+        ``KEYSTONE_DECODE_AHEAD`` / ``KEYSTONE_RING_CAPACITY`` /
+        ``KEYSTONE_AUTOTUNE`` / ``KEYSTONE_AUTOTUNE_INTERVAL``), any field
+        overridable by keyword."""
+        cfg = {
+            "decode_threads": image_loaders.decode_threads(),
+            "decode_ahead": image_loaders.decode_ahead(),
+            "ring_capacity": ring_capacity(),
+            "autotune": _env_flag("KEYSTONE_AUTOTUNE"),
+            "autotune_interval": _env_int("KEYSTONE_AUTOTUNE_INTERVAL", 4, 1),
+        }
+        cfg.update({k: v for k, v in overrides.items() if v is not None})
+        return cls(**cfg)
+
+    def window(self) -> int:
+        """In-flight decode window: effective pool width + decode-ahead."""
+        return max(1, self.decode_threads) + max(0, self.decode_ahead)
+
+    def record(self) -> dict:
+        return dataclasses.asdict(self)
 
 
 class _Cancelled(Exception):
@@ -148,10 +261,13 @@ class _Ring:
 
     _END = object()
 
-    def __init__(self, capacity: int, stats: StreamStats):
+    def __init__(self, config: StreamConfig, stats: StreamStats):
         self._q: collections.deque = collections.deque()
         self._cond = threading.Condition()
-        self._capacity = capacity
+        # Capacity is read from the LIVE config on every put: a mid-stream
+        # retune takes effect at the next enqueue (shrinking below the
+        # current depth just blocks the producer until the consumer drains).
+        self._config = config
         self._stats = stats
         self._closed = False
         self._stopped = False
@@ -170,7 +286,7 @@ class _Ring:
         when the consumer stopped the stream."""
         with self._cond:
             stalled = False
-            while len(self._q) >= self._capacity and not self._stopped:
+            while len(self._q) >= max(1, self._config.ring_capacity) and not self._stopped:
                 if not stalled:
                     self._stats.producer_stalls += 1
                     stalled = True
@@ -235,36 +351,66 @@ class IngestStream:
         decode_ahead_slots: int | None = None,
         capacity: int | None = None,
         transfer: bool = True,
+        config: StreamConfig | None = None,
+        tuner=None,
     ):
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         self._path = path
         self._batch_size = batch_size
         self._keep = keep
-        self._num_threads = num_threads or image_loaders.decode_threads()
-        self._ahead = (
-            decode_ahead_slots
-            if decode_ahead_slots is not None
-            else image_loaders.decode_ahead()
-        )
+        # The stream's live knob set: an explicit StreamConfig, or an
+        # env-seeded one; the legacy per-stream kwargs override its initial
+        # values.  The config object is SHARED with the caller/tuner —
+        # mutations retune the running stream.
+        if config is None:
+            config = StreamConfig.from_env(
+                decode_threads=num_threads,
+                decode_ahead=decode_ahead_slots,
+                ring_capacity=capacity,
+            )
+        else:
+            if num_threads is not None:
+                config.decode_threads = num_threads
+                config.max_decode_threads = max(
+                    config.max_decode_threads, num_threads
+                )
+            if decode_ahead_slots is not None:
+                config.decode_ahead = decode_ahead_slots
+            if capacity is not None:
+                config.ring_capacity = capacity
+            if num_threads is not None or decode_ahead_slots is not None or capacity is not None:
+                # Legacy overrides must pass the same validation the
+                # constructor enforces (num_threads=0 etc. raise, never
+                # silently configure a dead stream).
+                config.__post_init__()
+        self.config = config
         self._transfer = transfer
-        self.stats = StreamStats(
-            ring_capacity=capacity if capacity is not None else ring_capacity()
-        )
-        self._ring = _Ring(self.stats.ring_capacity, self.stats)
+        self.stats = StreamStats(ring_capacity=config.ring_capacity)
+        self._ring = _Ring(config, self.stats)
         self._workers: list[threading.Thread] = []
         self._chunk_counter = 0
+        self.tuner = tuner
+        if self.tuner is None and config.autotune:
+            # Lazy import: optimize imports ingest at module level; the
+            # reverse edge resolves only when a stream actually autotunes.
+            from .optimize import IngestAutotuner
+
+            self.tuner = IngestAutotuner()
+        if self.tuner is not None:
+            self.tuner.attach(self)
         # One line per stream so operators can see the effective ingest
         # configuration (the env knobs resolved) without env spelunking.
         _logger.info(
             "streaming ingest %s: batch=%d threads=%d ahead=%d ring=%d "
-            "transfer=%s",
+            "transfer=%s autotune=%s",
             path,
             batch_size,
-            self._num_threads,
-            self._ahead,
-            self.stats.ring_capacity,
+            config.decode_threads,
+            config.decode_ahead,
+            config.ring_capacity,
             transfer,
+            bool(self.tuner),
         )
         self._iter = self._drain()
         self._thread = threading.Thread(
@@ -305,8 +451,12 @@ class IngestStream:
         return pool.submit(traced)
 
     def _produce(self):
+        # The pool is sized at the retune CEILING; the effective width is
+        # the in-flight window (config.decode_threads), consulted per
+        # member — so the tuner can widen/narrow decode mid-stream without
+        # rebuilding the pool.
         pool = ThreadPoolExecutor(
-            max_workers=self._num_threads,
+            max_workers=self.config.max_decode_threads,
             thread_name_prefix="keystone-decode",
             initializer=self._register_worker,
         )
@@ -356,7 +506,11 @@ class IngestStream:
                         window.append(
                             (name, self._submit_decode(pool, name, data))
                         )
-                        if len(window) >= self._num_threads + self._ahead:
+                        # Live window limit: a retune takes effect at the
+                        # next member ("while" drains DOWN to a narrowed
+                        # window; completion order through the FIFO window
+                        # is unchanged by any width).
+                        while len(window) >= self.config.window():
                             drain_one()
                     while window:
                         drain_one()
@@ -420,6 +574,21 @@ class IngestStream:
         ):
             yield item
 
+    def _publish_metrics(self) -> None:
+        """Chunk-boundary gauges: the live trace-metrics the autotuner (and
+        any operator dashboard) reads — ring depth plus the current knob
+        values, alongside the stats counters."""
+        m = trace.metrics
+        # A retune may have moved the capacity: keep the stats record (the
+        # bench/chaos artifact) consistent with the ring's live bound.
+        self.stats.ring_capacity = self.config.ring_capacity
+        m.gauge("ingest_ring_depth", self._ring.depth())
+        m.gauge("ingest_decode_threads", self.config.decode_threads)
+        m.gauge("ingest_decode_ahead", self.config.decode_ahead)
+        m.gauge("ingest_ring_capacity", self.config.ring_capacity)
+        m.gauge("ingest_producer_stalls", self.stats.producer_stalls)
+        m.gauge("ingest_consumer_stalls", self.stats.consumer_stalls)
+
     def _drain(self):
         pending: collections.deque = collections.deque()
         try:
@@ -433,6 +602,11 @@ class IngestStream:
                     # overlaps the consumer's work on the PREVIOUS chunk
                     # still being featurized.
                     item.device = jax.device_put(item.host)
+                self._publish_metrics()
+                if self.tuner is not None:
+                    # Chunk boundary: the closed-loop controller reads the
+                    # stall counters/gauges and may retune the config.
+                    self.tuner.on_chunk(self)
                 pending.append(item)
                 if len(pending) >= DEVICE_BUFFERS:
                     yield from self._yield_consumed(pending.popleft())
@@ -482,16 +656,22 @@ def stream_batches(
     decode_ahead_slots: int | None = None,
     capacity: int | None = None,
     transfer: bool = True,
+    config: StreamConfig | None = None,
+    tuner=None,
 ) -> IngestStream:
     """Stream shape-bucketed device batches from a tar (or directory of
     tars) of images.
 
     ``keep``: member-name predicate (label filtering before decode).
-    ``num_threads`` / ``decode_ahead_slots``: decoder sizing, defaulting to
-    the ``KEYSTONE_DECODE_THREADS`` / ``KEYSTONE_DECODE_AHEAD`` env knobs.
-    ``capacity``: ring depth (``KEYSTONE_RING_CAPACITY`` default).
+    ``config``: a :class:`StreamConfig` — the stream's LIVE knob set
+    (env-seeded via :meth:`StreamConfig.from_env` when omitted); mutate it
+    mid-stream to retune, or set ``config.autotune`` (env
+    ``KEYSTONE_AUTOTUNE=1``) for the closed-loop controller.
+    ``num_threads`` / ``decode_ahead_slots`` / ``capacity``: legacy
+    per-stream overrides of the config's initial values.
     ``transfer=False`` skips the H2D stage (host-only consumers, decode
-    benchmarking).
+    benchmarking).  ``tuner``: an explicit controller (anything with
+    ``attach(stream)`` / ``on_chunk(stream)``) instead of the default.
 
     Yields :class:`StreamBatch` in assembly order; use as a context
     manager (or iterate to exhaustion) so the decode threads are released,
@@ -504,4 +684,6 @@ def stream_batches(
         decode_ahead_slots=decode_ahead_slots,
         capacity=capacity,
         transfer=transfer,
+        config=config,
+        tuner=tuner,
     )

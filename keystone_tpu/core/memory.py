@@ -484,6 +484,69 @@ def plan_program(
     return _admission_event(plan)
 
 
+def plan_cache_bytes(
+    label: str,
+    nbytes: int,
+    *,
+    mesh=None,
+    budget: int | None | object = _UNSET,
+    headroom: float = 0.5,
+) -> MemoryPlan:
+    """Admit or deny holding ``nbytes`` of materialized intermediates
+    resident — the auto-Cacher's admission gate (core.optimize).  Data-only:
+    no program to compile, so admission is a straight byte comparison
+    against the HBM budget (the minimum per-chip free HBM under a ``mesh``,
+    exactly like :func:`plan_program`'s mesh mode; callers divide sharded
+    cache bytes per chip before calling).
+
+    ``headroom``: fraction of the budget caches may claim — a cache that
+    fills ALL free HBM starves the very solve it was meant to speed up, so
+    the default admits at most half.  No budget known -> admitted
+    unanalyzed (CPU backends without stats), same skip-never-guess rule as
+    every other admission path.  Denials are counted under
+    ``cache_admission_denied`` and land on the trace timeline as
+    ``hbm_admission`` events like any program plan."""
+    if mesh is not None and budget is _UNSET:
+        budget, _worst = min_chip_budget(mesh)
+    if budget is _UNSET:
+        budget = hbm_budget()
+    mesh_axes = dict(mesh.shape) if mesh is not None else None
+    if budget is None:
+        return _admission_event(MemoryPlan(
+            label=label,
+            admitted=True,
+            reason=(
+                "no HBM budget known (no device memory_stats and "
+                f"{HBM_BUDGET_ENV} unset) — cache admission skipped"
+            ),
+            output_bytes=int(nbytes),
+            total_bytes=int(nbytes),
+            mesh_axes=mesh_axes,
+        ))
+    allowed = int(budget * headroom)
+    admitted = int(nbytes) <= allowed
+    h = fmt_bytes
+    reason = (
+        ("fits: " if admitted else "DENIED: ")
+        + ("per-chip " if mesh is not None else "")
+        + f"cached {h(nbytes)} vs {h(allowed)} "
+        f"(budget {h(budget)} x headroom {headroom})"
+    )
+    plan = MemoryPlan(
+        label=label,
+        admitted=admitted,
+        reason=reason,
+        budget_bytes=allowed,
+        output_bytes=int(nbytes),
+        total_bytes=int(nbytes),
+        analyzed=True,
+        mesh_axes=mesh_axes,
+    )
+    if not admitted:
+        counters.record("cache_admission_denied", f"{label}: {reason}")
+    return _admission_event(plan)
+
+
 # -- OOM detection / recovery -------------------------------------------------
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")

@@ -28,7 +28,10 @@ chained estimator first pushes the data through the upstream transformer.
 from __future__ import annotations
 
 import abc
+import contextlib
 import dataclasses
+import json
+import threading
 import time
 from typing import Any, Callable, Generic, Sequence, TypeVar
 
@@ -146,6 +149,39 @@ def transformer(fn: Callable) -> FunctionTransformer:
     return FunctionTransformer(fn)
 
 
+# -- per-node reuse tracking (the auto-Cacher's fit-path measurement) ---------
+
+_reuse_tls = threading.local()
+
+
+@contextlib.contextmanager
+def track_reuse():
+    """Count node executions by object identity while the block runs.
+
+    Yields a dict mapping ``id(node) -> execution count``, filled in as
+    pipelines run.  This is how the cost-based optimizer (core.optimize)
+    measures REUSE: run the workload's fit pattern on a sample under the
+    tracker — e.g. ``ChainedEstimator.fit`` pushes data through the
+    upstream transformer once, and applying the returned fitted pipeline
+    pushes it through again — and a node counted twice is an intermediate
+    that would be recomputed, i.e. a Cacher candidate (KeystoneML's
+    PipelineRuntimeEstimator derived the same counts from DAG lineage).
+
+    Per-thread (trackers on other threads are unaffected); nesting is not
+    supported — the inner tracker wins until it exits."""
+    counts: dict = {}
+    prev = getattr(_reuse_tls, "counts", None)
+    _reuse_tls.counts = counts
+    try:
+        yield counts
+    finally:
+        _reuse_tls.counts = prev
+
+
+def _record_exec(node, counts) -> None:
+    counts[id(node)] = counts.get(id(node), 0) + 1
+
+
 def _node_label(n: Transformer) -> str:
     """Stable display name for a pipeline node (FunctionTransformers carry
     their wrapped function's name)."""
@@ -208,6 +244,33 @@ class PipelineProfile:
             "nodes": [n.record() for n in self.nodes],
         }
 
+    def to_json(self) -> str:
+        """The profile as one JSON document (record-first artifacts: bench
+        rows and chaos records embed profiles instead of repr-only objects
+        that die with the process).  Round-trips through
+        :meth:`from_json` minus the ``output`` batch."""
+        return json.dumps(self.record())
+
+    @classmethod
+    def from_json(cls, doc: str) -> "PipelineProfile":
+        rec = json.loads(doc)
+        return cls(
+            nodes=[
+                NodeProfile(
+                    index=n["index"],
+                    name=n["name"],
+                    seconds=n["seconds"],
+                    output_bytes=n["output_bytes"],
+                    dtype=n.get("dtype"),
+                    shape=tuple(n["shape"]) if n.get("shape") else None,
+                    leaves=n.get("leaves", 1),
+                )
+                for n in rec["nodes"]
+            ],
+            total_seconds=rec["total_seconds"],
+            input_bytes=rec["input_bytes"],
+        )
+
     def summary(self) -> str:
         parts = [
             f"{n.name}: {n.seconds * 1e3:.2f}ms -> {n.output_bytes}B"
@@ -232,10 +295,40 @@ class Pipeline(Transformer):
             else:
                 flat.append(n)
         self.nodes = tuple(flat)
+        # Positions of memoizing Cacher nodes (auto-inserted by
+        # core.optimize): empty for almost every pipeline, so __call__ pays
+        # one truthiness check unless caching is actually in play.
+        self._memo_cachers = tuple(
+            i
+            for i, n in enumerate(self.nodes)
+            if isinstance(n, Cacher) and getattr(n, "memoize", False)
+        )
 
     def __call__(self, batch):
-        for n in self.nodes:
+        counts = getattr(_reuse_tls, "counts", None)
+        cachers = self._memo_cachers
+        start = 0
+        key = None
+        if cachers and not isinstance(batch, jax.core.Tracer):
+            # Resume from the LAST memoizing Cacher that has this exact
+            # input's intermediate cached: the nodes before it — shared by
+            # identity with the pipeline the cache was filled through — are
+            # not recomputed.  Under jit tracing the memo path is inert
+            # (XLA owns buffers there).
+            key = batch
+            for pos in reversed(cachers):
+                hit, value = self.nodes[pos]._memo_lookup(key)
+                if hit:
+                    batch = value
+                    start = pos + 1
+                    break
+        for i in range(start, len(self.nodes)):
+            n = self.nodes[i]
+            if counts is not None:
+                _record_exec(n, counts)
             batch = n(batch)
+            if key is not None and i in cachers:
+                n._memo_store(key, batch)
         return batch
 
     def apply_item(self, item):
@@ -329,6 +422,16 @@ class FunctionEstimator(Estimator):
         return self.fn(data)
 
 
+def _apply_counted(xform: Transformer, data):
+    """Apply ``xform`` with reuse tracking for BARE transformers too — a
+    Pipeline counts its own nodes, but a single-node xform applied directly
+    would otherwise be invisible to :func:`track_reuse`."""
+    counts = getattr(_reuse_tls, "counts", None)
+    if counts is not None and not isinstance(xform, Pipeline):
+        _record_exec(xform, counts)
+    return xform(data)
+
+
 class ChainedEstimator(Estimator):
     """``xform then_estimator est``: fitting first maps data through ``xform``
     and returns ``xform >> est.fit(xform(data))`` (reference Transformer.scala:37-44)."""
@@ -338,7 +441,7 @@ class ChainedEstimator(Estimator):
         self.est = est
 
     def fit(self, data):
-        fitted = self.est.fit(self.xform(data))
+        fitted = self.est.fit(_apply_counted(self.xform, data))
         return self.xform.then(fitted)
 
 
@@ -350,7 +453,7 @@ class ChainedLabelEstimator(LabelEstimator):
         self.est = est
 
     def fit(self, data, labels):
-        fitted = self.est.fit(self.xform(data), labels)
+        fitted = self.est.fit(_apply_counted(self.xform, data), labels)
         return self.xform.then(fitted)
 
 
@@ -373,18 +476,31 @@ class Identity(Transformer):
         return "Identity()"
 
 
-@node(data_fields=(), meta_fields=("name", "sharding"))
+@node(data_fields=(), meta_fields=("name", "sharding", "memoize"))
 class Cacher(Transformer):
     """Materialization barrier (reference nodes/util/Cacher.scala:13-23).
 
     Spark's ``.cache()`` becomes: commit the value to device memory (optionally
     with an explicit sharding) and block until resident.  Inside ``jit`` it is
     the identity — XLA manages materialization there.
+
+    ``memoize=True`` (set by the cost-based optimizer, core.optimize) makes
+    the barrier also REMEMBER one materialized value, keyed on the identity
+    of the *pipeline input* that produced it: Spark's ``.cache()`` meant the
+    second pass over the same RDD read the cached partitions instead of
+    recomputing the lineage, and the memo reproduces that on the eager path
+    — a :class:`Pipeline` containing this node skips the prefix nodes when
+    re-applied to the very same input object.  Single-entry by design (the
+    fit path's training batch); a different input computes normally and is
+    NOT stored, so applying the fitted pipeline to test data never evicts
+    the training cache or pins test intermediates.  The memo is runtime
+    state, not pytree data — it never flows through jit or checkpoints.
     """
 
-    def __init__(self, name: str | None = None, sharding=None):
+    def __init__(self, name: str | None = None, sharding=None, memoize: bool = False):
         self.name = name
         self.sharding = sharding
+        self.memoize = memoize
 
     def __call__(self, batch):
         if isinstance(batch, jax.core.Tracer):
@@ -393,5 +509,25 @@ class Cacher(Transformer):
             batch = jax.device_put(batch, self.sharding)
         return jax.block_until_ready(batch)
 
+    # -- memo plumbing (driven by Pipeline.__call__, keyed on ITS input) ------
+
+    def _memo_lookup(self, key) -> tuple[bool, Any]:
+        memo = getattr(self, "_memo", None)
+        if memo is not None and memo[0] is key:
+            return True, memo[1]
+        return False, None
+
+    def _memo_store(self, key, value) -> None:
+        # First-key-wins: the fit path arms the cache with the training
+        # batch; later inputs (test data) pass through unmemoized.  The key
+        # object is held strongly so its id() can never be reused while the
+        # entry lives.
+        if getattr(self, "_memo", None) is None:
+            self._memo = (key, value)
+
+    def clear_memo(self) -> None:
+        """Release the cached intermediate (and its device memory)."""
+        self._memo = None
+
     def __repr__(self):
-        return f"Cacher({self.name or ''})"
+        return f"Cacher({self.name or ''}{', memoize' if getattr(self, 'memoize', False) else ''})"

@@ -435,6 +435,12 @@ class Metrics:
         with self._lock:
             self._gauges[name] = value
 
+    def gauge_value(self, name: str, default: float | None = None) -> float | None:
+        """Read one gauge back (controllers — the ingest autotuner — consume
+        the same live registry the exporters snapshot)."""
+        with self._lock:
+            return self._gauges.get(name, default)
+
     # histograms -------------------------------------------------------------
     def observe(self, name: str, value: float) -> None:
         with self._lock:

@@ -208,6 +208,96 @@ class StupidBackoffModel:
         return out
 
 
+def sharded_scores(
+    ngram_counts: dict,
+    unigram_counts: dict,
+    num_shards: int,
+    alpha: float = 0.4,
+    indexer: NGramIndexerImpl | None = None,
+    queries=None,
+) -> tuple[dict, dict]:
+    """Score every counted ngram through the SHARDED path the reference's
+    InitialBigramPartitioner implies (StupidBackoff.scala:25-58): the count
+    table is partitioned by :func:`shard_by_initial_bigram`, each shard
+    scores its ngrams against ONLY its local counts (plus the broadcast
+    unigram table, which the reference also replicates), and an ngram whose
+    backoff shortens past its shard's key — removing the farthest word
+    changes the first two words, i.e. the shard — is re-routed to the
+    owning shard for the next round with its accumulated alpha, exactly the
+    shuffle a multi-host run would perform.  At most ``max_order - 1``
+    rounds.
+
+    ``queries``: the ngrams to score — default every counted ngram (the
+    reference's scoresRDD).  Counted ngrams score shard-locally in one
+    round; UNSEEN queries exercise the backoff re-route.
+
+    Returns ``(scores, shard_sizes)``; the scores are identical to the
+    single-table :meth:`StupidBackoffModel.score` (asserted by the
+    workload), because each lookup happens on the shard that owns the
+    ngram — the co-location invariant made executable."""
+    ix = indexer or NGramIndexerImpl()
+    num_tokens = sum(unigram_counts.values())
+    shards: dict[int, dict] = defaultdict(dict)
+    for ngram, cnt in ngram_counts.items():
+        shards[shard_by_initial_bigram(ngram, num_shards, ix)][ngram] = cnt
+    shard_sizes = {s: len(tab) for s, tab in shards.items()}
+
+    scores: dict = {}
+    # Work item: (original ngram, current backoff form, accumulated alpha,
+    # backed_off), grouped by the shard owning the CURRENT form.
+    work: dict[int, list] = defaultdict(list)
+    for ngram in (queries if queries is not None else ngram_counts):
+        ngram = ix.pack(ngram) if isinstance(ngram, (list, tuple)) else ngram
+        work[shard_by_initial_bigram(ngram, num_shards, ix)].append(
+            (ngram, ngram, 1.0, False)
+        )
+    while work:
+        next_work: dict[int, list] = defaultdict(list)
+        for shard_id, items in work.items():
+            local = shards.get(shard_id, {})
+            for orig, ngram, accum, backed_off in items:
+                order = ix.ngram_order(ngram)
+                if order == 1:
+                    # Parity with StupidBackoffModel.score: a DIRECT
+                    # order-1 query reads the ngram table (orders 2..n, so
+                    # usually 0); only a BACKED-OFF unigram reads the
+                    # broadcast unigram table.
+                    freq = (
+                        unigram_counts.get(ix.unpack(ngram, 0), 0)
+                        if backed_off
+                        else local.get(ngram, 0)
+                    )
+                    scores[orig] = accum * freq / num_tokens
+                    continue
+                freq = local.get(ngram, 0)
+                if freq != 0:
+                    context = ix.remove_current_word(ngram)
+                    if order != 2:
+                        # same first two words as the ngram: SHARD-LOCAL by
+                        # the co-location invariant
+                        context_freq = local.get(context, 0)
+                    else:
+                        context_freq = unigram_counts.get(
+                            ix.unpack(context, 0), 0
+                        )
+                    if context_freq == 0:
+                        raise ValueError(
+                            f"ngram {ngram} has count {freq} but its "
+                            f"context {context} has zero count on shard "
+                            f"{shard_id} — fit with consecutive orders"
+                        )
+                    scores[orig] = accum * freq / context_freq
+                    continue
+                # Back off: the shortened form may live on another shard —
+                # the re-route is the multi-host shuffle.
+                shorter = ix.remove_farthest_word(ngram)
+                next_work[
+                    shard_by_initial_bigram(shorter, num_shards, ix)
+                ].append((orig, shorter, accum * alpha, True))
+        work = next_work
+    return scores, shard_sizes
+
+
 class StupidBackoffEstimator(Estimator):
     """Fit from (ngram, count) pairs (reference StupidBackoffEstimator:149-182)."""
 

@@ -10,6 +10,9 @@ fixed-dimension feature rows are scattered back to original order.
 
 from __future__ import annotations
 
+import time
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -106,7 +109,74 @@ def scatter_features(buckets: dict, transform, n_total: int, feature_dim: int) -
     return out
 
 
+def plan_pca_materialization(
+    desc_buckets: dict, batch_pca, reuse: int, *, mesh=None,
+    label: str = "pca_descriptors",
+):
+    """Auto-Cacher decision for the PCA-projected descriptor buckets
+    (core.optimize): the FV workloads consume them up to twice — GMM
+    sampling, then Fisher featurization — and today always hold the whole
+    projected set resident between the two.  Profile the projection on the
+    smallest bucket, scale seconds/bytes to the full set, and run the
+    caching inequality through the HBM admission gate.  Returns
+    ``(CachePlan, materialize)``: ``materialize=False`` means each consumer
+    projects on the fly (bit-identical — the projection is deterministic)
+    instead of pinning the set through the GMM EM fit."""
+    from ..core import optimize
+
+    shape, (_idx, probe) = min(
+        desc_buckets.items(), key=lambda kv: kv[1][1].size
+    )
+    # Warm the projection's compile before timing: a cold first call would
+    # fold one-off JIT time into probe_secs and then SCALE it by the
+    # dataset ratio, overpricing recompute and biasing every decision
+    # toward materialize.
+    jax.block_until_ready(batch_pca(probe))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(batch_pca(probe))
+    probe_secs = time.perf_counter() - t0
+    probe_cols = int(probe.shape[0]) * int(probe.shape[2])
+    total_cols = sum(
+        int(d.shape[0]) * int(d.shape[2]) for _, d in desc_buckets.values()
+    )
+    scale = total_cols / max(1, probe_cols)
+    plan = optimize.plan_caches(
+        [
+            optimize.CacheCandidate(
+                index=0,
+                name=label,
+                seconds=probe_secs * scale,
+                output_bytes=int(out.nbytes * scale),
+                reuse=reuse,
+            )
+        ],
+        mesh=mesh,
+    )
+    return plan, plan.decisions[0].cached
+
+
 # -- streaming ingest (core.ingest) -------------------------------------------
+
+
+def record_stream_autotune(src, stream) -> None:
+    """Append a finished stream's autotuner record to its source (one
+    record per streaming pass — ImageNet streams a source once per
+    descriptor branch).  No-op without a tuner."""
+    if stream.tuner is not None:
+        records = getattr(src, "last_autotune", None) or []
+        records.append(stream.tuner.record())
+        src.last_autotune = records
+
+
+def collect_autotune(train, test) -> dict:
+    """The ``results["autotune"]`` section: per-split knob-trajectory
+    record lists accumulated by :func:`record_stream_autotune` (empty dict
+    when nothing streamed with a tuner)."""
+    return {
+        split: getattr(src, "last_autotune", None)
+        for split, src in (("train", train), ("test", test))
+        if getattr(src, "last_autotune", None)
+    }
 
 
 def _ordered_names(pairs: list, n: int) -> list:

@@ -19,9 +19,9 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import trace
+from ..core import optimize, trace
 from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
-from ..core.ingest import stream_batches
+from ..core.ingest import StreamConfig, stream_batches
 from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
 from ..core.resilience import assert_all_finite
@@ -41,8 +41,11 @@ from ..solvers.weighted import BlockWeightedLeastSquaresEstimator
 from ..utils.stats import get_err_percent
 from .fv_common import (
     bucket_by_shape,
+    collect_autotune,
     fisher_feature_pipeline,
     grayscale,
+    plan_pca_materialization,
+    record_stream_autotune,
     sample_columns,
     scatter_features,
     shard_batch,
@@ -65,6 +68,8 @@ class ImageNetStreamSource:
     data_path: str
     labels_path: str
     batch_size: int = 32
+    #: closed-loop ingest autotuner on this source's streams (--autoTune)
+    autotune: bool = False
 
     def __post_init__(self):
         self._names: list | None = None
@@ -116,9 +121,13 @@ def _streaming_buckets(src: ImageNetStreamSource, per_batch) -> dict:
     def keep(name: str) -> bool:
         return name.split("/")[0] in lm
 
-    with stream_batches(src.data_path, src.batch_size, keep=keep) as st:
+    cfg = StreamConfig.from_env(autotune=True) if src.autotune else None
+    with stream_batches(
+        src.data_path, src.batch_size, keep=keep, config=cfg
+    ) as st:
         buckets, names = stream_descriptor_buckets(st, per_batch)
     src.record_names(names)
+    record_stream_autotune(src, st)
     return buckets
 
 
@@ -152,19 +161,32 @@ class ImageNetSiftLcsFVConfig:
     # Whole-fitted-pipeline checkpoint stem (core.checkpoint): both
     # branches' PCA + GMM plus the weighted block solve in one artifact.
     pipeline_file: str | None = None
+    # Cost-based auto-Cacher (core.optimize): per-branch probe-measured
+    # decision on whether PCA-projected descriptors stay resident through
+    # the GMM EM fit or are re-projected per consumer under a tight HBM
+    # budget.  Decision tables in results["cache_plan"].
+    auto_cache: bool = False
 
 
 class _Log(Logging):
     pass
 
 
-def _fit_branch(conf: ImageNetSiftLcsFVConfig, desc_buckets: dict, pca_file, gmm_files, seed: int):
+def _fit_branch(
+    conf: ImageNetSiftLcsFVConfig, desc_buckets: dict, pca_file, gmm_files,
+    seed: int, label: str = "branch", mesh=None,
+):
     """Fit (or load) the branch's PCA + GMM from TRAIN descriptors only —
     the reference fits once and applies the same featurizer to test
     (ImageNetSiftLcsFV.scala:69,91,145).
 
-    Returns (batch_pca, gmm, train_pca_desc): the PCA-projected train
-    buckets are returned so callers never re-project the training set."""
+    Returns (batch_pca, gmm, train_pca_desc, cache_plan): the PCA-projected
+    train buckets are returned so callers never re-project the training
+    set.  With ``conf.auto_cache`` the optimizer decides whether that
+    projection stays resident through the GMM EM fit (the HBM-heavy phase)
+    or is deferred and re-projected — the reference's always-cache becomes
+    a measured choice; ``cache_plan`` is the decision table (None when the
+    pass is off)."""
     if pca_file is not None:
         pca_mat = jnp.asarray(
             np.loadtxt(pca_file, delimiter=",", ndmin=2).T, jnp.float32
@@ -174,16 +196,30 @@ def _fit_branch(conf: ImageNetSiftLcsFVConfig, desc_buckets: dict, pca_file, gmm
         pca_mat = compute_pca(samples.T, conf.desc_dim)
     batch_pca = BatchPCATransformer(pca_mat)
 
-    pca_desc = {
-        shape: (idx, batch_pca(descs))
-        for shape, (idx, descs) in desc_buckets.items()
-    }
+    def make_pca_desc() -> dict:
+        return {
+            shape: (idx, batch_pca(descs))
+            for shape, (idx, descs) in desc_buckets.items()
+        }
 
     mean_f, var_f, wts_f = gmm_files
+    cache_plan = None
+    materialize = True
+    if conf.auto_cache:
+        reuse = (0 if mean_f is not None else 1) + 1
+        cache_plan, materialize = plan_pca_materialization(
+            desc_buckets, batch_pca, reuse, mesh=mesh,
+            label=f"{label}_pca_descriptors",
+        )
+    pca_desc = make_pca_desc() if materialize else None
+
     if mean_f is not None:
         gmm = GaussianMixtureModel.load(mean_f, var_f, wts_f)
     else:
-        gmm_samples = sample_columns(pca_desc, conf.num_gmm_samples, seed + 1)
+        gmm_samples = sample_columns(
+            pca_desc if pca_desc is not None else make_pca_desc(),
+            conf.num_gmm_samples, seed + 1,
+        )
         # The reference caps the EM training set at 1e6 samples regardless of
         # numGmmSamples (shuffleArray(...).take(1e6),
         # ImageNetSiftLcsFV.scala:85-86) — match it to bound EM compute/HBM.
@@ -192,7 +228,11 @@ def _fit_branch(conf: ImageNetSiftLcsFVConfig, desc_buckets: dict, pca_file, gmm
         gmm = GaussianMixtureModelEstimator(conf.vocab_size).fit(gmm_samples.T)
     assert_all_finite(gmm, "branch GMM fit")
 
-    return batch_pca, gmm, pca_desc
+    if pca_desc is None:
+        # Deferred projection: materialized only now, AFTER the EM fit
+        # released its working set — the recompute the plan priced in.
+        pca_desc = make_pca_desc()
+    return batch_pca, gmm, pca_desc, cache_plan
 
 
 def sift_descriptor_buckets(
@@ -242,10 +282,13 @@ def branch_features(
     mesh=None,
 ):
     """Fit transformers on train, apply to train AND test.  Returns the
-    fitted (batch_pca, gmm) too so callers can checkpoint the branch."""
+    fitted (batch_pca, gmm) too so callers can checkpoint the branch, and
+    the auto-Cacher decision table (None when the pass is off)."""
     train_desc = descriptor_fn(conf, train_images, mesh)
-    batch_pca, gmm, train_pca_desc = _fit_branch(
-        conf, train_desc, pca_file, gmm_files, seed
+    batch_pca, gmm, train_pca_desc, cache_plan = _fit_branch(
+        conf, train_desc, pca_file, gmm_files, seed,
+        label=descriptor_fn.__name__.replace("_descriptor_buckets", ""),
+        mesh=mesh,
     )
     fisher = fisher_feature_pipeline(gmm)
     feat_dim = 2 * conf.desc_dim * conf.vocab_size
@@ -256,7 +299,7 @@ def branch_features(
     test_feats = scatter_features(
         test_desc, lambda d: fisher(batch_pca(d)), len(test_images), feat_dim
     )
-    return train_feats, test_feats, batch_pca, gmm
+    return train_feats, test_feats, batch_pca, gmm, cache_plan
 
 
 def branch_test_features(
@@ -292,6 +335,7 @@ def run(
     log = _Log()
     t0 = time.perf_counter()
 
+    sift_plan = lcs_plan = None
     if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
         # Load-or-fit of the whole fitted pipeline: skip training
         # featurization and every fit; score test with restored state.
@@ -311,7 +355,7 @@ def run(
         )
     else:
         with stage_timer("sift_branch"):
-            train_sift, test_sift, sift_pca, sift_gmm = branch_features(
+            train_sift, test_sift, sift_pca, sift_gmm, sift_plan = branch_features(
                 conf,
                 train.images,
                 test.images,
@@ -322,7 +366,7 @@ def run(
                 mesh,
             )
         with stage_timer("lcs_branch"):
-            train_lcs, test_lcs, lcs_pca, lcs_gmm = branch_features(
+            train_lcs, test_lcs, lcs_pca, lcs_gmm, lcs_plan = branch_features(
                 conf,
                 train.images,
                 test.images,
@@ -374,6 +418,19 @@ def run(
         "top1_err_percent": get_err_percent(topk, test.labels, 1),
         "seconds": time.perf_counter() - t0,
     }
+    plans = {
+        name: plan.record()
+        for name, plan in (("sift", sift_plan), ("lcs", lcs_plan))
+        if plan is not None
+    }
+    if plans:
+        results["cache_plan"] = plans
+        for name, plan in (("sift", sift_plan), ("lcs", lcs_plan)):
+            if plan is not None:
+                log.log_info("%s branch %s", name, plan.summary())
+    autotune = collect_autotune(train, test)
+    if autotune:
+        results["autotune"] = autotune
     log.log_info("TEST Top-%d error is: %s %%", k, err)
     return results
 
@@ -411,6 +468,20 @@ def main(argv=None):
         type=int,
         default=32,
         help="images per streamed device batch (--streamIngest only)",
+    )
+    p.add_argument(
+        "--autoCache",
+        action="store_true",
+        help="cost-based auto-Cacher (core.optimize): per-branch "
+        "probe-measured decision on PCA-descriptor residency vs "
+        "re-projection (KEYSTONE_AUTOCACHE=1 equivalent)",
+    )
+    p.add_argument(
+        "--autoTune",
+        action="store_true",
+        help="closed-loop ingest autotuner on --streamIngest streams: "
+        "retune decode width / ring depth / decode-ahead mid-stream "
+        "(KEYSTONE_AUTOTUNE=1 equivalent)",
     )
     p.add_argument(
         "--mesh",
@@ -456,6 +527,7 @@ def main(argv=None):
         num_gmm_samples=a.numGmmSamples,
         num_classes=a.numClasses,
         pipeline_file=a.pipelineFile,
+        auto_cache=a.autoCache or optimize.auto_cache_env(),
     )
     if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
         # Restored runs never touch training data — skip decoding the
@@ -463,13 +535,15 @@ def main(argv=None):
         train = LabeledImages([], np.zeros(0, np.int32), [])
     elif a.streamIngest:
         train = ImageNetStreamSource(
-            conf.train_location, conf.label_path, batch_size=a.streamBatchSize
+            conf.train_location, conf.label_path,
+            batch_size=a.streamBatchSize, autotune=a.autoTune,
         )
     else:
         train = imagenet_loader(conf.train_location, conf.label_path)
     if a.streamIngest:
         test = ImageNetStreamSource(
-            conf.test_location, conf.label_path, batch_size=a.streamBatchSize
+            conf.test_location, conf.label_path,
+            batch_size=a.streamBatchSize, autotune=a.autoTune,
         )
     else:
         test = imagenet_loader(conf.test_location, conf.label_path)

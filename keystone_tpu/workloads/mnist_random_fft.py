@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import trace
+from ..core import optimize, trace
 from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
 from ..core.pipeline import Pipeline
@@ -50,6 +50,13 @@ class MnistRandomFFTConfig:
     #: ``BlockLeastSquaresEstimator.fit(checkpoint=, resume_from=)``.
     solve_checkpoint: object = None
     solve_resume: object = None
+    #: Cost-based auto-Cacher (core.optimize): decide from the MEASURED
+    #: featurize cost whether the FFT feature batches stay resident through
+    #: the train-split evaluation (reuse=2: solve + eval) or are freed
+    #: after the solve and recomputed at eval — under a tight
+    #: ``KEYSTONE_HBM_BUDGET`` the optimizer picks recompute instead of
+    #: OOMing on residency.  Decision table in ``results["cache_plan"]``.
+    auto_cache: bool = False
 
 
 def build_featurizer_batches(conf: MnistRandomFFTConfig):
@@ -102,15 +109,45 @@ def run(
         train_data, nvalid = jnp.asarray(train.data), None
         test_data = jnp.asarray(test.data)
 
-    with stage_timer("featurize"):
-        training_batches = [
+    def featurize_training():
+        batches = [
             ZipVectors.apply([chain(train_data) for chain in chains])
             for chains in batch_featurizer
         ]
         # Sync inside the stage: jnp dispatch is async, and an unsynced
         # featurize span would read ~0 while the compute leaked into the
         # solve span's time.
-        jax.block_until_ready(training_batches)
+        jax.block_until_ready(batches)
+        return batches
+
+    t_feat = time.perf_counter()
+    with stage_timer("featurize"):
+        training_batches = featurize_training()
+    feat_secs = time.perf_counter() - t_feat
+
+    cache_plan = None
+    keep_features = True
+    if conf.auto_cache:
+        # Auto-Cacher decision on the featurized training batches: they are
+        # consumed twice (the block solve, then the train-split streaming
+        # eval).  Caching = the status-quo residency; a denial frees them
+        # after the solve and recomputes at eval time — measured featurize
+        # seconds vs materialized bytes, admitted per-chip under a mesh.
+        cache_plan = optimize.plan_caches(
+            [
+                optimize.CacheCandidate(
+                    index=0,
+                    name="fft_features",
+                    seconds=feat_secs,
+                    output_bytes=sum(int(b.nbytes) for b in training_batches),
+                    reuse=2,
+                )
+            ],
+            mesh=mesh,
+            dataset_rows=n_train,
+        )
+        keep_features = cache_plan.decisions[0].cached
+        log.log_info("%s", cache_plan.summary())
 
     with stage_timer("solve"):
         solver = BlockLeastSquaresEstimator(
@@ -130,12 +167,20 @@ def run(
             # like a model.
             assert_all_finite(model, "mnist random-fft model")
 
+    if not keep_features:
+        # The plan priced residency above a recompute: release the feature
+        # batches' memory through the solve->eval gap and rebuild them at
+        # eval (bit-identical — the featurizers are deterministic).
+        training_batches = None
+
     test_batches = [
         ZipVectors.apply([chain(test_data) for chain in chains])
         for chains in batch_featurizer
     ]
 
     results: dict = {}
+    if cache_plan is not None:
+        results["cache_plan"] = cache_plan.record()
 
     def train_eval(pred):
         predicted = MaxClassifier()(pred[:n_train])
@@ -156,6 +201,8 @@ def run(
     # Streaming evaluation after each block, as the reference does (:70-86);
     # the last invocation sees the full-model prediction.
     with stage_timer("eval"):
+        if training_batches is None:
+            training_batches = featurize_training()
         model.apply_and_evaluate(training_batches, train_eval)
         model.apply_and_evaluate(test_batches, test_eval)
 
@@ -193,6 +240,13 @@ def main(argv=None):
         help="BCD solve state path to resume a preempted fit from",
     )
     p.add_argument(
+        "--autoCache",
+        action="store_true",
+        help="cost-based auto-Cacher (core.optimize): decide feature-batch "
+        "residency from measured featurize cost vs HBM budget "
+        "(KEYSTONE_AUTOCACHE=1 equivalent)",
+    )
+    p.add_argument(
         "--trace",
         default=None,
         metavar="PATH",
@@ -216,6 +270,7 @@ def main(argv=None):
         seed=a.seed,
         solve_checkpoint=a.solveCheckpoint,
         solve_resume=a.resumeFrom,
+        auto_cache=a.autoCache or optimize.auto_cache_env(),
     )
     # Labels in the files are 1-indexed (reference :40-42)
     with stage_timer("load"):

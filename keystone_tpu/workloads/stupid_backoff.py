@@ -6,12 +6,15 @@ NGramsFeaturizer(2..n) -> NGramsCounts(noAdd) -> StupidBackoffEstimator ->
 scores.  Prints corpus statistics and the first 100 trained scores exactly
 as the reference (:45-53).
 
-``--numParts`` keeps flag parity with the reference, where it controls the
-InitialBigramPartitioner shuffle (StupidBackoff.scala:25-58); here scoring
-is host-local, so the flag drives the same sharding function
-(``shard_by_initial_bigram``) to report the shard layout a multi-host run
-would use — and to assert the co-location invariant (every ngram on the
-same shard as its scoring context).
+``--numParts`` drives the reference's InitialBigramPartitioner layout
+(StupidBackoff.scala:25-58) as an EXECUTABLE scoring path
+(``ops.ngram_lm.sharded_scores``): the count table is partitioned by
+initial bigram, each shard scores its ngrams against only shard-local
+counts (plus the broadcast unigram table), and backoffs that shorten past
+a shard's key are re-routed between rounds — the multi-host shuffle, run
+host-locally.  The run asserts the sharded scores equal the single-table
+model's bit-for-bit, which is the co-location invariant made a test
+rather than a comment.
 """
 
 from __future__ import annotations
@@ -23,10 +26,9 @@ from dataclasses import dataclass
 
 from ..core.logging import Logging, configure_logging
 from ..ops.ngram_lm import (
-    NGramIndexerImpl,
     NGramsCounts,
     StupidBackoffEstimator,
-    shard_by_initial_bigram,
+    sharded_scores,
 )
 from ..ops.nlp import NGramsFeaturizer, Tokenizer, fit_word_frequency_encoder
 
@@ -64,25 +66,32 @@ def run(conf: StupidBackoffConfig, lines: list) -> dict:
     language_model = StupidBackoffEstimator(unigram_counts).fit(ngram_counts)
     scores = language_model.scores()
 
-    # Shard layout a multi-host run would use (InitialBigramPartitioner):
-    # every ngram must land with its scoring context (same first two words).
-    indexer = NGramIndexerImpl()
-    shard_sizes = Counter()
-    for ngram in language_model.ngram_counts:
-        shard = shard_by_initial_bigram(ngram, conf.num_parts, indexer)
-        shard_sizes[shard] += 1
-        if indexer.ngram_order(ngram) > 2:
-            context = indexer.remove_current_word(ngram)
-            if shard_by_initial_bigram(context, conf.num_parts, indexer) != shard:
-                raise ValueError(
-                    f"ngram {ngram} not co-located with context {context}"
-                )
+    # The sharded scoring path (InitialBigramPartitioner, executable):
+    # partition counts by initial bigram, score shard-locally with backoff
+    # re-routing between rounds, and hold it to the single-table oracle.
+    shard_scores, shard_sizes = sharded_scores(
+        language_model.ngram_counts,
+        unigram_counts,
+        conf.num_parts,
+        alpha=language_model.alpha,
+    )
+    if shard_scores != scores:
+        diff = {
+            k for k in scores
+            if shard_scores.get(k) != scores[k]
+        }
+        raise ValueError(
+            f"sharded scoring diverged from the single-table model on "
+            f"{len(diff)} ngram(s) (e.g. {sorted(diff)[:3]}) — the "
+            "co-location invariant is broken"
+        )
 
     results = {
         "num_tokens": language_model.num_tokens,
         "vocab_size": len(unigram_counts),
         "num_ngrams": len(scores),
-        "shard_sizes": dict(shard_sizes),
+        "shard_sizes": dict(Counter(shard_sizes)),
+        "sharded_scoring_equal": True,
         "seconds": time.perf_counter() - t0,
     }
     log.log_info(

@@ -19,9 +19,9 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import trace
+from ..core import optimize, trace
 from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
-from ..core.ingest import stream_batches
+from ..core.ingest import StreamConfig, stream_batches
 from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
 from ..core.resilience import assert_all_finite
@@ -40,8 +40,11 @@ from ..solvers.gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
 from ..solvers.pca import BatchPCATransformer, compute_pca
 from .fv_common import (
     bucket_by_shape,
+    collect_autotune,
     fisher_feature_pipeline,
     grayscale,
+    plan_pca_materialization,
+    record_stream_autotune,
     sample_columns,
     scatter_features,
     shard_batch,
@@ -61,6 +64,8 @@ class VOCStreamSource:
     labels_path: str
     name_prefix: str = "VOCdevkit/VOC2007/JPEGImages/"
     batch_size: int = 64
+    #: closed-loop ingest autotuner on this source's streams (--autoTune)
+    autotune: bool = False
 
     def __post_init__(self):
         self._names: list | None = None
@@ -124,6 +129,11 @@ class SIFTFisherConfig:
     # Resumable-solve state path: the BCD fit checkpoints after every block
     # and restarts from the last completed block if the state file exists.
     solve_checkpoint: str | None = None
+    # Cost-based auto-Cacher (core.optimize): decide from a measured probe
+    # whether the PCA-projected descriptors stay resident between GMM
+    # sampling and Fisher featurization, or are re-projected per consumer
+    # under a tight HBM budget.  Decision table in results["cache_plan"].
+    auto_cache: bool = False
 
 
 class _Log(Logging):
@@ -154,11 +164,15 @@ def extract_sift_buckets(
         def keep(name: str) -> bool:
             return name.startswith(src.name_prefix) and name in lm
 
-        with stream_batches(src.data_path, src.batch_size, keep=keep) as st:
+        cfg = StreamConfig.from_env(autotune=True) if src.autotune else None
+        with stream_batches(
+            src.data_path, src.batch_size, keep=keep, config=cfg
+        ) as st:
             buckets, names = stream_descriptor_buckets(
                 st, lambda dev: sift(grayscale(dev))
             )
         src.record_names(names)
+        record_stream_autotune(src, st)
         return buckets
     out = {}
     for shape, (idx, batch) in bucket_by_shape(images).items():
@@ -182,6 +196,7 @@ def run(
     t0 = time.perf_counter()
 
     feat_dim = 2 * conf.desc_dim * conf.vocab_size
+    results_cache_plan = None
 
     # Load-or-fit of the WHOLE fitted pipeline (SURVEY §5 generalized): when
     # the checkpoint exists, training featurization and all fits are skipped
@@ -215,10 +230,27 @@ def run(
                 pca_mat = compute_pca(samples.T, conf.desc_dim)
             batch_pca = BatchPCATransformer(pca_mat)
 
-            pca_desc = {
-                shape: (idx, batch_pca(descs))
-                for shape, (idx, descs) in train_desc.items()
-            }
+            def make_pca_desc() -> dict:
+                return {
+                    shape: (idx, batch_pca(descs))
+                    for shape, (idx, descs) in train_desc.items()
+                }
+
+            materialize = True
+            if conf.auto_cache:
+                # Auto-Cacher decision: the projected set is consumed by
+                # GMM sampling (when fitting one) and Fisher featurization.
+                reuse = (0 if conf.gmm_mean_file is not None else 1) + 1
+                cache_plan, materialize = plan_pca_materialization(
+                    train_desc, batch_pca, reuse, mesh=mesh,
+                    label="voc_pca_descriptors",
+                )
+                log.log_info("%s", cache_plan.summary())
+                results_cache_plan = cache_plan.record()
+            # Cached: one resident projection feeds both consumers (the
+            # status quo).  Denied: each consumer projects on the fly —
+            # deterministic, so samples and features are bit-identical.
+            pca_desc = make_pca_desc() if materialize else None
 
         # Part 2a: GMM — fit on sampled PCA'd columns, or load (:59-70)
         with stage_timer("gmm"):
@@ -228,7 +260,8 @@ def run(
                 )
             else:
                 gmm_samples = sample_columns(
-                    pca_desc, conf.num_gmm_samples, conf.seed + 1
+                    pca_desc if pca_desc is not None else make_pca_desc(),
+                    conf.num_gmm_samples, conf.seed + 1,
                 )
                 gmm = GaussianMixtureModelEstimator(conf.vocab_size).fit(
                     gmm_samples.T
@@ -239,7 +272,10 @@ def run(
         with stage_timer("fisher_features"):
             fisher = fisher_feature_pipeline(gmm)
             train_features = jnp.asarray(
-                scatter_features(pca_desc, fisher, len(train), feat_dim)
+                scatter_features(
+                    pca_desc if pca_desc is not None else make_pca_desc(),
+                    fisher, len(train), feat_dim,
+                )
             )
 
         # Part 4: linear model (:84-86) — mesh-distributed when given one;
@@ -289,6 +325,12 @@ def run(
         "map": float(np.mean(aps)),
         "seconds": time.perf_counter() - t0,
     }
+    if results_cache_plan is not None:
+        results["cache_plan"] = results_cache_plan
+    autotune = collect_autotune(train, test)
+    if autotune:
+        results["autotune"] = autotune
+        log.log_info("ingest autotune: %s", autotune)
     log.log_info("TEST APs are: %s", ",".join(str(a) for a in aps))
     log.log_info("TEST MAP is: %s", results["map"])
     return results
@@ -332,6 +374,20 @@ def main(argv=None):
         help="images per streamed device batch (--streamIngest only)",
     )
     p.add_argument(
+        "--autoCache",
+        action="store_true",
+        help="cost-based auto-Cacher (core.optimize): probe-measured "
+        "decision on PCA-descriptor residency vs re-projection "
+        "(KEYSTONE_AUTOCACHE=1 equivalent)",
+    )
+    p.add_argument(
+        "--autoTune",
+        action="store_true",
+        help="closed-loop ingest autotuner on --streamIngest streams: "
+        "retune decode width / ring depth / decode-ahead mid-stream "
+        "(KEYSTONE_AUTOTUNE=1 equivalent)",
+    )
+    p.add_argument(
         "--mesh",
         default=None,
         help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
@@ -362,6 +418,7 @@ def main(argv=None):
         num_gmm_samples=a.numGmmSamples,
         pipeline_file=a.pipelineFile,
         solve_checkpoint=a.solveCheckpoint,
+        auto_cache=a.autoCache or optimize.auto_cache_env(),
     )
     if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
         # Restored runs never touch training data — skip decoding the
@@ -369,13 +426,15 @@ def main(argv=None):
         train = MultiLabeledImages([], [], [])
     elif a.streamIngest:
         train = VOCStreamSource(
-            conf.train_location, conf.label_path, batch_size=a.streamBatchSize
+            conf.train_location, conf.label_path,
+            batch_size=a.streamBatchSize, autotune=a.autoTune,
         )
     else:
         train = voc_loader(conf.train_location, conf.label_path)
     if a.streamIngest:
         test = VOCStreamSource(
-            conf.test_location, conf.label_path, batch_size=a.streamBatchSize
+            conf.test_location, conf.label_path,
+            batch_size=a.streamBatchSize, autotune=a.autoTune,
         )
     else:
         test = voc_loader(conf.test_location, conf.label_path)

@@ -37,6 +37,11 @@ draws its parameters — fully deterministic):
 * ``stream_hang`` — an injected decoder-thread hang under the streaming
   path, bounded by ``resilience.deadline``: typed ``DeadlineExceeded``,
   never a deadlocked ring.
+* ``autotune_thrash`` — forced OSCILLATING retunes of every ingest knob
+  (decode width, ring depth, decode-ahead) at every chunk boundary
+  mid-stream: the typed-or-equal invariant must hold under retuning —
+  streamed features bit-equal to a static-knob stream, every thread
+  joined.
 """
 
 from __future__ import annotations
@@ -87,6 +92,7 @@ FAMILIES = (
     "deadline",
     "stream_corrupt",
     "stream_hang",
+    "autotune_thrash",
 )
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
@@ -191,6 +197,11 @@ def make_schedule(seed: int) -> Fault:
         return Fault(
             kind,
             {"hang_at": int(rng.integers(1, 6)), "seconds": 0.8},
+        )
+    if kind == "autotune_thrash":
+        return Fault(
+            kind,
+            {"batch": int(rng.integers(2, 5)), "period": int(rng.integers(1, 3))},
         )
     return Fault("deadline", {"seconds": 1.0})
 
@@ -390,7 +401,7 @@ def _ingest_phase(fault: Fault, tmpdir: str, seed: int) -> None:
         )
 
 
-def _stream_featurize(tar_path: str, batch: int):
+def _stream_featurize(tar_path: str, batch: int, config=None, tuner=None):
     """The streaming-path probe pipeline: core.ingest stream -> per-image
     device featurize -> scatter back to stream order (the real consumer
     API, fv_common.scatter_features_streaming)."""
@@ -404,7 +415,9 @@ def _stream_featurize(tar_path: str, batch: int):
             [jnp.mean(x, axis=(1, 2, 3)), jnp.max(x, axis=(1, 2, 3))], axis=1
         )
     )
-    with ingest.stream_batches(tar_path, batch) as st:
+    with ingest.stream_batches(
+        tar_path, batch, config=config, tuner=tuner
+    ) as st:
         feats, names = scatter_features_streaming(st, feat, 2)
     if not st.join(10.0):
         raise ChaosOracleError(
@@ -491,6 +504,73 @@ def _stream_hang_phase(fault: Fault, tmpdir: str, seed: int) -> None:
     )
 
 
+class _ThrashTuner:
+    """Adversarial autotuner: flip EVERY ingest knob between its extremes
+    every ``period`` chunks — the worst-case retune schedule a closed-loop
+    controller could emit.  The typed-or-equal invariant says knob motion
+    may change speed, never results."""
+
+    def __init__(self, period: int):
+        self._period = max(1, period)
+        self._chunks = 0
+        self._cfg = None
+        self.retunes = 0
+
+    def attach(self, stream) -> None:
+        self._cfg = stream.config
+
+    def on_chunk(self, stream) -> None:
+        self._chunks += 1
+        if self._chunks % self._period:
+            return
+        cfg = self._cfg
+        wide = cfg.decode_threads == 1
+        cfg.decode_threads = cfg.max_decode_threads if wide else 1
+        cfg.decode_ahead = 8 if wide else 0
+        cfg.ring_capacity = 8 if wide else 1
+        self.retunes += 1
+
+
+def _autotune_thrash_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """Oscillating mid-stream retunes: features must stay BIT-IDENTICAL to
+    a static-knob stream over the same tar, with every retune observed and
+    every thread joined."""
+    rng = np.random.default_rng(seed)
+    tar_path = os.path.join(tmpdir, f"chaos_thrash_{seed}.tar")
+    faults.make_image_tar(tar_path, _N_STREAM_IMAGES, rng)
+    batch = int(fault.params["batch"])
+    static_feats, static_names = _stream_featurize(tar_path, batch)
+
+    tuner = _ThrashTuner(int(fault.params["period"]))
+    cfg = ingest.StreamConfig(
+        decode_threads=1, decode_ahead=0, ring_capacity=1,
+        max_decode_threads=4,
+    )
+    thrash_feats, thrash_names = _stream_featurize(
+        tar_path, batch, config=cfg, tuner=tuner
+    )
+    if tuner.retunes < 1:
+        raise ChaosOracleError(
+            "thrash tuner never retuned — the oscillation schedule did not "
+            "exercise mid-stream reconfiguration"
+        )
+    if thrash_names != static_names:
+        raise ChaosOracleError(
+            "retuned stream lost/reordered data: "
+            f"{thrash_names} != {static_names}"
+        )
+    if not np.array_equal(thrash_feats, static_feats):
+        raise ChaosOracleError(
+            "streamed features under knob thrash differ from the "
+            "static-knob stream — retuning changed RESULTS, not just speed"
+        )
+    counters.record(
+        "chaos_autotune_thrash",
+        f"seed {seed}: {tuner.retunes} oscillating retune(s), output "
+        "bit-equal",
+    )
+
+
 def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
     """Apply one schedule to the workload; returns the results dict (or
     raises).  Each branch is the minimal faithful injection for its
@@ -518,6 +598,10 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "stream_hang":
         return _stream_hang_phase(fault, tmpdir, seed)  # always raises
+
+    if fault.kind == "autotune_thrash":
+        _autotune_thrash_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
 
     if fault.kind == "nan_input":
         frac = fault.params["frac"]

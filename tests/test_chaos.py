@@ -25,6 +25,7 @@ EXPECTED_COUNTER = {
     "deadline": "deadline_exceeded",
     "stream_corrupt": "corrupt_image",
     "stream_hang": "deadline_exceeded",
+    "autotune_thrash": "chaos_autotune_thrash",
 }
 
 
@@ -58,6 +59,9 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     assert {"preempt_resume", "deadline"} <= kinds
     # Streaming-ingest coverage (ISSUE 4): >= 2 streaming schedules in tier-1
     assert {"stream_corrupt", "stream_hang"} <= kinds
+    # Mid-stream retune coverage (ISSUE 6): the typed-or-equal invariant
+    # must be exercised under oscillating autotuner knob motion
+    assert "autotune_thrash" in kinds
 
 
 def test_schedules_are_deterministic():

@@ -11,6 +11,7 @@ from keystone_tpu.ops.ngram_lm import (
     NGramsCounts,
     StupidBackoffEstimator,
     shard_by_initial_bigram,
+    sharded_scores,
 )
 from keystone_tpu.ops.nlp import NGramsFeaturizer, Tokenizer
 
@@ -68,6 +69,51 @@ class TestStupidBackoff:
         lm = self._fit()
         scores = lm.scores()
         assert scores and all(0.0 <= s <= 1.0 for s in scores.values())
+
+    @pytest.mark.parametrize("num_shards", (1, 2, 4, 16))
+    def test_sharded_scores_equal_single_table(self, num_shards):
+        # The sharded scoring path (InitialBigramPartitioner executable,
+        # StupidBackoff.scala:25-58): shard-local scoring with backoff
+        # re-routing must reproduce the single-table scores exactly, at
+        # any shard count.
+        lm = self._fit()
+        want = lm.scores()
+        got, shard_sizes = sharded_scores(
+            lm.ngram_counts, lm.unigram_counts, num_shards, alpha=lm.alpha
+        )
+        assert got == want
+        assert sum(shard_sizes.values()) == len(lm.ngram_counts)
+        assert set(shard_sizes) <= set(range(num_shards))
+
+    def test_sharded_scores_route_cross_shard_backoffs(self):
+        # Counted ngrams score in one shard-local round; UNSEEN queries
+        # back off — removing the farthest word changes the first two
+        # words, i.e. usually the shard — so these only score right if the
+        # between-round re-route (the multi-host shuffle analog) works.
+        lm = self._fit()
+        unseen = [
+            ("is-unseen", "coming"),           # -> unigram "coming"
+            ("summer", "finals", "coming"),    # -> ("finals","coming") -> unigram
+            ("winter", "is", "soon"),          # -> ("is","soon") -> unigram
+        ]
+        got, _ = sharded_scores(
+            lm.ngram_counts, lm.unigram_counts, 8, alpha=lm.alpha,
+            queries=unseen,
+        )
+        for q in unseen:
+            assert got[q] == lm.score(q), q
+
+    def test_sharded_scores_unigram_query_parity(self):
+        # A DIRECT order-1 query reads the ngram table (single-table
+        # semantics: usually 0 — unigrams live in the broadcast table),
+        # while a backed-off unigram reads the unigram table; both must
+        # match the single-table model.
+        lm = self._fit()
+        got, _ = sharded_scores(
+            lm.ngram_counts, lm.unigram_counts, 8, alpha=lm.alpha,
+            queries=[("coming",)],
+        )
+        assert got[("coming",)] == lm.score(("coming",))
 
     def test_context_colocation_invariant(self):
         # requireNGramColocation (:27-46): every ngram's backoff context maps

@@ -54,7 +54,8 @@ def main(argv=None) -> int:
         "--stream",
         action="store_true",
         help="run only the streaming-ingest fault schedules "
-        "(stream_corrupt / stream_hang families, core.ingest path)",
+        "(stream_corrupt / stream_hang / autotune_thrash families, "
+        "core.ingest path)",
     )
     p.add_argument("--workload", default="mnist", choices=("mnist", "cifar"))
     p.add_argument(
@@ -74,10 +75,15 @@ def main(argv=None) -> int:
     else:
         seeds = chaos.FULL_SEEDS if a.full else chaos.TIER1_SEEDS
     if a.stream:
+
+        def is_stream(seed: int) -> bool:
+            kind = chaos.make_schedule(seed).kind
+            return kind.startswith("stream_") or kind == "autotune_thrash"
+
         seeds = tuple(
             s
             for s in (chaos.FULL_SEEDS if a.seed is None else seeds)
-            if chaos.make_schedule(s).kind.startswith("stream_")
+            if is_stream(s)
         )
         if not seeds:
             print("no streaming schedules in the selected seed set")
