@@ -39,21 +39,49 @@ Resilience invariants preserved from the eager loaders:
   decode pool; producer exceptions surface on the consumer's next
   ``__next__``.  ``join()`` lets tests assert every thread exited.
 
+Two attacks on the decode wall itself (BENCH_r05: threaded decode speedup
+1.04x — the pool is GIL-bound — while device featurize runs 15-17k
+images/sec):
+
+* **process decode backend** (``KEYSTONE_DECODE_BACKEND=process``) — a
+  pool of SPAWNED worker processes decodes members truly in parallel; raw
+  tar member bytes go in over per-worker queues, decoded pixels come back
+  in ``multiprocessing.shared_memory`` blocks the chunk assembly stacks
+  straight out of.  Worker crashes respawn (counted
+  ``decode_worker_respawn``; a task that keeps killing workers becomes a
+  counted ``decode_worker_lost`` skip), hangs fall to the same
+  ``resilience.deadline`` contract as a hung decode thread, and every
+  worker is joined — and every shm block released — on stream exit.
+* **snapshot cache** (``KEYSTONE_SNAPSHOT_DIR``, core.snapshot) — the
+  first pass over a tar tees its decoded chunks to disk; later passes
+  stream the shards through the same ring at IO speed.  Staleness and
+  shard corruption are counted fallbacks to live decode
+  (``snapshot_stale`` / ``snapshot_fallback``), never silently wrong
+  pixels — the fallback re-decode cross-checks the chunk prefix the
+  consumer already received and dies typed
+  (:class:`SnapshotFallbackDivergence`) if the survivor sequences
+  diverged rather than scramble ordinals.
+
 Every sizing knob lives in a mutable :class:`StreamConfig` (env-seeded:
 the ``KEYSTONE_DECODE_THREADS`` / ``KEYSTONE_DECODE_AHEAD`` /
 ``KEYSTONE_RING_CAPACITY`` values are INITIAL settings, no longer frozen
 at construction) consulted at every decision point, so the closed-loop
 autotuner (core.optimize.IngestAutotuner, ``KEYSTONE_AUTOTUNE=1``) can
-retune decode width, ring depth, and decode-ahead mid-stream.  Knobs
-change concurrency and buffering only — never ordering or content.
+retune decode width, ring depth, decode-ahead — and now the decode
+BACKEND (promoted to ``process`` when it observes threaded scaling
+flatline) — mid-stream.  Knobs change concurrency and buffering only —
+never ordering or content.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import itertools
 import logging
+import multiprocessing
 import os
+import queue as _queue
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -64,6 +92,7 @@ import jax
 import numpy as np
 
 from ..loaders import image_loaders
+from . import snapshot as ksnap
 from . import trace
 from .resilience import counters
 
@@ -127,6 +156,23 @@ def _env_int(name: str, default: int, minimum: int) -> int:
     return val
 
 
+#: Decode backends a stream can run: GIL-bound thread pool (PIL/native
+#: decode release the GIL, but entropy decode + colorspace still serialize
+#: badly — BENCH_r05 measured 1.04x threaded "speedup") or true parallel
+#: spawned worker processes returning pixels via shared memory.
+DECODE_BACKENDS = ("thread", "process")
+
+
+def decode_backend_env() -> str:
+    """``KEYSTONE_DECODE_BACKEND``: ``thread`` (default) or ``process``."""
+    raw = os.environ.get("KEYSTONE_DECODE_BACKEND", "").strip() or "thread"
+    if raw not in DECODE_BACKENDS:
+        raise ValueError(
+            f"KEYSTONE_DECODE_BACKEND={raw!r} must be one of {DECODE_BACKENDS}"
+        )
+    return raw
+
+
 @dataclasses.dataclass
 class StreamConfig:
     """The LIVE knob set of one ingest stream.
@@ -157,6 +203,24 @@ class StreamConfig:
     max_decode_threads: int = 0  # 0 -> resolved to >= decode_threads in __post_init__
     autotune: bool = False  #: create an IngestAutotuner for this stream
     autotune_interval: int = 4  #: chunks between controller evaluations
+    #: Decode backend: "thread" (GIL-bound pool) or "process" (spawned
+    #: workers + shared-memory return path).  Consulted PER MEMBER, so the
+    #: autotuner can promote a running stream to process decode when it
+    #: observes threaded scaling flatline (core.optimize.IngestAutotuner).
+    decode_backend: str = "thread"
+    #: Process-backend worker count; 0 -> resolved to decode_threads.
+    decode_procs: int = 0
+    #: Snapshot cache root (None = off): first pass over the tar writes
+    #: decoded chunks here, later passes stream them at IO speed
+    #: (core.snapshot).  ``snapshot_mode="featurized"`` is handled ABOVE
+    #: the ring by the workload helpers (fv_common) — the ingest stream
+    #: itself only materializes decoded chunks.
+    snapshot_dir: str | None = None
+    snapshot_mode: str = "decoded"
+    #: Extra key material for the snapshot content hash — REQUIRED when the
+    #: stream uses a ``keep`` member filter (the filter selects the member
+    #: set, so an unkeyed filter would alias different survivor sets).
+    snapshot_extra: str | None = None
 
     def __post_init__(self):
         if self.decode_threads < 1:
@@ -168,6 +232,22 @@ class StreamConfig:
         if self.autotune_interval < 1:
             raise ValueError(
                 f"autotune_interval must be >= 1, got {self.autotune_interval}"
+            )
+        if self.decode_backend not in DECODE_BACKENDS:
+            raise ValueError(
+                f"decode_backend={self.decode_backend!r} must be one of "
+                f"{DECODE_BACKENDS}"
+            )
+        if self.decode_procs < 0:
+            raise ValueError(
+                f"decode_procs must be >= 0, got {self.decode_procs}"
+            )
+        if self.decode_procs == 0:
+            self.decode_procs = self.decode_threads
+        if self.snapshot_mode not in ksnap.MODES:
+            raise ValueError(
+                f"snapshot_mode={self.snapshot_mode!r} must be one of "
+                f"{ksnap.MODES}"
             )
         if self.max_decode_threads == 0:
             self.max_decode_threads = max(self.decode_threads, _host_cores())
@@ -184,7 +264,9 @@ class StreamConfig:
     def from_env(cls, **overrides) -> "StreamConfig":
         """Env-seeded defaults (``KEYSTONE_DECODE_THREADS`` /
         ``KEYSTONE_DECODE_AHEAD`` / ``KEYSTONE_RING_CAPACITY`` /
-        ``KEYSTONE_AUTOTUNE`` / ``KEYSTONE_AUTOTUNE_INTERVAL``), any field
+        ``KEYSTONE_AUTOTUNE`` / ``KEYSTONE_AUTOTUNE_INTERVAL`` /
+        ``KEYSTONE_DECODE_BACKEND`` / ``KEYSTONE_DECODE_PROCS`` /
+        ``KEYSTONE_SNAPSHOT_DIR`` / ``KEYSTONE_SNAPSHOT_MODE``), any field
         overridable by keyword."""
         cfg = {
             "decode_threads": image_loaders.decode_threads(),
@@ -192,6 +274,10 @@ class StreamConfig:
             "ring_capacity": ring_capacity(),
             "autotune": _env_flag("KEYSTONE_AUTOTUNE"),
             "autotune_interval": _env_int("KEYSTONE_AUTOTUNE_INTERVAL", 4, 1),
+            "decode_backend": decode_backend_env(),
+            "decode_procs": _env_int("KEYSTONE_DECODE_PROCS", 0, 0),
+            "snapshot_dir": ksnap.snapshot_dir_env(),
+            "snapshot_mode": ksnap.snapshot_mode_env(),
         }
         cfg.update({k: v for k, v in overrides.items() if v is not None})
         return cls(**cfg)
@@ -206,6 +292,348 @@ class StreamConfig:
 
 class _Cancelled(Exception):
     """Internal: the consumer stopped the stream — unwind the producer."""
+
+
+class SnapshotFallbackDivergence(RuntimeError):
+    """The live re-decode behind a corrupt-shard snapshot fallback stopped
+    matching the chunk prefix the consumer already received from the
+    snapshot (a transient counted skip — e.g. ``decode_worker_lost`` —
+    shifted the survivor sequence between the two passes).  The served
+    prefix is valid original data, but continuing would assign the same
+    stream ordinals to different images, silently scrambling the
+    consumer's scatter — so the stream dies TYPED (and counted,
+    ``snapshot_fallback_divergence``) instead."""
+
+
+# -- the multiprocess decode backend ------------------------------------------
+
+
+def _decode_worker_main(task_q, result_q):
+    """Entry point of one SPAWNED decode worker process.
+
+    Receives ``(task_id, raw_member_bytes)``, decodes with the same
+    ``image_loaders.decode_image`` the thread path runs (bit-identity by
+    construction), and publishes the pixels through a
+    ``multiprocessing.shared_memory`` block sized to the decoded array —
+    the parent maps the block and stacks STRAIGHT from it into the chunk
+    assembly, so no pickled array ever crosses the result queue.  A
+    ``None`` task is the shutdown sentinel; a corrupt member answers
+    ``(task_id, None, None, None)`` (the parent counts the skip)."""
+    from multiprocessing import shared_memory
+
+    from ..loaders import image_loaders as _loaders
+    from ..loaders.native_decode import available as _native_available
+
+    _native_available()  # one-time build/load before the decode loop
+    while True:
+        item = task_q.get()
+        if item is None:
+            break
+        tid, data = item
+        try:
+            img = _loaders.decode_image(data)
+        except Exception:  # noqa: BLE001 — a crash here is a counted skip
+            img = None
+        if img is None:
+            result_q.put((tid, None, None, None))
+            continue
+        shm = shared_memory.SharedMemory(create=True, size=img.nbytes)
+        np.ndarray(img.shape, img.dtype, buffer=shm.buf)[:] = img
+        # The block stays REGISTERED with the resource tracker (shared
+        # with the parent via the spawn tracker_fd): the tracker reaps
+        # only when main + every worker have exited, so worker exit or
+        # respawn can never unlink a block the parent is assembling from,
+        # and a SIGKILL landing anywhere around this put — even before
+        # the queue's feeder thread flushes the name to the pipe — leaves
+        # the block tracker-known and reclaimed at interpreter exit.  The
+        # parent's unlink() unregisters on the normal path.
+        result_q.put((tid, shm.name, img.shape, img.dtype.str))
+        shm.close()
+
+
+class _ShmArray:
+    """Parent-side view of one worker-decoded image living in shared
+    memory.  ``arr`` is a zero-copy ndarray over the block; ``release()``
+    (after chunk assembly copies the pixels out) closes and unlinks it."""
+
+    __slots__ = ("_pool", "shm", "arr")
+
+    def __init__(self, pool, shm, shape, dtype):
+        self._pool = pool
+        self.shm = shm
+        self.arr = np.ndarray(shape, dtype, buffer=shm.buf)
+
+    @property
+    def shape(self):
+        return self.arr.shape
+
+    def release(self) -> None:
+        self._pool._release(self.shm)
+
+
+class _ProcTask:
+    """Future-like handle for one member's process decode (same
+    ``result(timeout)`` surface as a thread-pool future, so the in-order
+    FIFO window holds either kind)."""
+
+    __slots__ = (
+        "id", "name", "data", "worker", "img", "done", "skip_reason",
+        "resubmits", "_pool",
+    )
+
+    def __init__(self, pool, tid: int, name: str, data: bytes):
+        self._pool = pool
+        self.id = tid
+        self.name = name
+        self.data = data  # retained until done: a dead worker's tasks resubmit
+        self.worker = None
+        self.img = None
+        self.done = False
+        self.skip_reason: str | None = None
+        self.resubmits = 0
+
+    def result(self, timeout: float):
+        return self._pool._wait(self, timeout)
+
+
+class _PoolWorker:
+    __slots__ = ("proc", "task_q", "pending")
+
+    def __init__(self, proc, task_q):
+        self.proc = proc
+        self.task_q = task_q
+        self.pending: dict = {}  # task_id -> _ProcTask
+
+
+class _ProcessDecodePool:
+    """True parallel decode: ``procs`` SPAWNED worker processes (no fork —
+    jax-unsafe), raw tar member bytes in over per-worker task queues,
+    decoded pixels back via shared memory.
+
+    Crash containment: a worker that dies (OOM-killed, SIGKILL chaos) is
+    detected on the next result wait — its pending tasks are resubmitted to
+    a freshly spawned replacement (counted ``decode_worker_respawn``); a
+    task that kills workers repeatedly becomes a counted skip
+    (``decode_worker_lost``) instead of a respawn storm.  A HUNG worker is
+    the consumer deadline's problem, exactly like a hung decode thread:
+    ``result()`` keeps timing out, the armed ``resilience.deadline`` fires
+    typed, and :meth:`shutdown` terminates the stragglers — the ring never
+    deadlocks and workers are always joined on stream exit.
+
+    Every live shared-memory block is registered in ``_live_shm`` until the
+    chunk assembly releases it, and :meth:`shutdown` force-releases the
+    registry — no ``/dev/shm`` segment outlives the stream (asserted by the
+    tier-1 suite)."""
+
+    MAX_RESUBMITS = 2
+
+    def __init__(self, procs: int, stats: StreamStats | None = None):
+        self._ctx = multiprocessing.get_context("spawn")
+        self._result_q = self._ctx.Queue()
+        self._workers: list[_PoolWorker] = []
+        self._inflight: dict = {}  # task_id -> _ProcTask
+        self._live_shm: dict = {}  # shm name -> SharedMemory
+        self._ids = itertools.count()
+        self._stats = stats
+        self._down = False
+        for _ in range(max(1, procs)):
+            self._spawn_worker()
+
+    # -- worker lifecycle ------------------------------------------------------
+
+    def _spawn_worker(self) -> _PoolWorker:
+        task_q = self._ctx.Queue()
+        proc = self._ctx.Process(
+            target=_decode_worker_main,
+            args=(task_q, self._result_q),
+            name="keystone-decode-proc",
+            daemon=True,
+        )
+        proc.start()
+        w = _PoolWorker(proc, task_q)
+        self._workers.append(w)
+        return w
+
+    def _reap_dead_workers(self) -> None:
+        for w in list(self._workers):
+            if w.proc.is_alive():
+                continue
+            self._workers.remove(w)
+            lost = list(w.pending.values())
+            w.pending.clear()
+            w.task_q.cancel_join_thread()
+            w.task_q.close()
+            counters.record(
+                "decode_worker_respawn",
+                f"pid {w.proc.pid} exited {w.proc.exitcode} with "
+                f"{len(lost)} task(s) pending — respawned",
+            )
+            trace.instant(
+                "decode_worker_respawn",
+                pid=w.proc.pid, exitcode=w.proc.exitcode, lost=len(lost),
+            )
+            if self._stats is not None:
+                self._stats.worker_respawns += 1
+            self._spawn_worker()
+            # Blame the crash on the worker's OLDEST pending task only —
+            # the FIFO worker was decoding it when it died (pending is
+            # insertion-ordered; later entries were still queued).
+            # Charging every co-pending task would let one poison member
+            # exhaust healthy members' resubmit budgets, skipping images
+            # the thread path keeps (breaking process-vs-thread
+            # bit-identity).
+            if lost:
+                lost[0].resubmits += 1
+            for t in lost:
+                if t.resubmits > self.MAX_RESUBMITS:
+                    # The task itself keeps killing workers: a counted
+                    # skip, never an infinite respawn loop.
+                    self._inflight.pop(t.id, None)
+                    t.img = None
+                    t.skip_reason = "decode_worker_lost"
+                    t.done = True
+                    t.data = None
+                else:
+                    self._dispatch(t)
+
+    # -- task flow -------------------------------------------------------------
+
+    def submit(self, name: str, data: bytes) -> _ProcTask:
+        if self._down:
+            raise RuntimeError("decode pool is shut down")
+        t = _ProcTask(self, next(self._ids), name, data)
+        self._inflight[t.id] = t
+        self._dispatch(t)
+        return t
+
+    def _dispatch(self, task: _ProcTask) -> None:
+        w = min(self._workers, key=lambda w: len(w.pending))
+        w.pending[task.id] = task
+        task.worker = w
+        w.task_q.put((task.id, task.data))
+
+    def _handle(self, item) -> None:
+        tid, shm_name, shape, dtype = item
+        task = self._inflight.pop(tid, None)
+        if shm_name is None:
+            if task is not None:
+                task.img = None
+                task.skip_reason = task.skip_reason or "corrupt_image"
+                task.done = True
+                task.data = None
+                if task.worker is not None:
+                    task.worker.pending.pop(tid, None)
+            return
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(name=shm_name)
+        if task is None or task.done:
+            # A resubmit raced the original worker's queued result: the
+            # duplicate block is surplus — release it immediately.
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+            return
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        # An instant, not an io_span: attaching the block is a zero-copy
+        # mmap (the pixels move later, in _emit's np.stack), so a derived
+        # mb_per_s here would report dict-insert latency as IPC bandwidth.
+        trace.instant(
+            "ingest.shm_recv", bytes=nbytes, member=task.name
+        )
+        self._live_shm[shm.name] = shm
+        task.img = _ShmArray(self, shm, shape, np.dtype(dtype))
+        task.done = True
+        task.data = None
+        if task.worker is not None:
+            task.worker.pending.pop(tid, None)
+
+    def _wait(self, task: _ProcTask, timeout: float):
+        end = time.monotonic() + timeout
+        while True:
+            drained = False
+            try:
+                item = self._result_q.get(timeout=_POLL_SECONDS / 5)
+                drained = True
+            except _queue.Empty:
+                item = None
+            while item is not None:
+                self._handle(item)
+                try:
+                    item = self._result_q.get_nowait()
+                except _queue.Empty:
+                    item = None
+            if task.done:
+                return task.img
+            if not drained:
+                self._reap_dead_workers()
+            if task.done:
+                return task.img
+            if time.monotonic() >= end:
+                raise _FutureTimeout()
+
+    def _release(self, shm) -> None:
+        self._live_shm.pop(shm.name, None)
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+    # -- shutdown --------------------------------------------------------------
+
+    def shutdown(self, clean: bool) -> None:
+        """Stop every worker (sentinel, then terminate/kill stragglers),
+        drain undelivered results, and force-release every live
+        shared-memory block.  Idempotent."""
+        if self._down:
+            return
+        self._down = True
+        for w in self._workers:
+            try:
+                w.task_q.put_nowait(None)
+            except (ValueError, OSError):
+                pass
+        end = time.monotonic() + (5.0 if clean else 1.0)
+        for w in self._workers:
+            w.proc.join(max(0.0, end - time.monotonic()))
+        for w in self._workers:
+            if w.proc.is_alive():
+                w.proc.terminate()
+                w.proc.join(1.0)
+            if w.proc.is_alive():
+                w.proc.kill()
+                w.proc.join(1.0)
+            w.task_q.cancel_join_thread()
+            w.task_q.close()
+        # Undelivered results hold blocks the parent never attached: attach
+        # and unlink each so nothing leaks in /dev/shm.
+        while True:
+            try:
+                item = self._result_q.get_nowait()
+            except (_queue.Empty, OSError, ValueError):
+                break
+            if item[1] is not None:
+                from multiprocessing import shared_memory
+
+                try:
+                    s = shared_memory.SharedMemory(name=item[1])
+                    s.close()
+                    s.unlink()
+                except FileNotFoundError:
+                    pass
+        self._result_q.cancel_join_thread()
+        self._result_q.close()
+        for shm in list(self._live_shm.values()):
+            self._release(shm)
+        self._inflight.clear()
+
+    def joined(self) -> bool:
+        return self._down and not any(
+            w.proc.is_alive() for w in self._workers
+        )
 
 
 @dataclasses.dataclass
@@ -246,6 +674,9 @@ class StreamStats:
     ring_max_depth: int = 0  #: high-water mark of assembled chunks queued
     producer_stalls: int = 0  #: puts that blocked on a full ring (backpressure)
     consumer_stalls: int = 0  #: gets that found the ring empty (decode-bound)
+    snapshot_chunks_read: int = 0  #: chunks served from the snapshot cache
+    snapshot_chunks_written: int = 0  #: chunks teed into a snapshot writer
+    worker_respawns: int = 0  #: process-backend decode workers respawned
 
     def record(self) -> dict:
         return dataclasses.asdict(self)
@@ -389,6 +820,14 @@ class IngestStream:
         self.stats = StreamStats(ring_capacity=config.ring_capacity)
         self._ring = _Ring(config, self.stats)
         self._workers: list[threading.Thread] = []
+        self._pool: ThreadPoolExecutor | None = None
+        self._proc_pool: _ProcessDecodePool | None = None
+        self._writer = None  #: core.snapshot.SnapshotWriter while teeing
+        self._skip_chunks = 0
+        #: (names, indices) per chunk already served from a snapshot when a
+        #: corrupt shard forced the live fallback — the oracle the
+        #: suppressed re-decode prefix must reproduce exactly.
+        self._served_prefix: list = []
         self._chunk_counter = 0
         self.tuner = tuner
         if self.tuner is None and config.autotune:
@@ -434,13 +873,47 @@ class IngestStream:
             except _FutureTimeout:
                 continue
 
-    def _submit_decode(self, pool, name: str, data: bytes):
-        """Submit one member's decode; when tracing is enabled each decode
-        becomes an ``ingest.decode`` span on ITS worker thread's timeline —
-        the parallel decode lanes are visible next to the consumer lane,
-        so decode/featurize overlap is a picture, not an inference.  The
-        module attribute is resolved at call time (the chaos harness
-        patches ``image_loaders.decode_image``)."""
+    def _ensure_thread_pool(self) -> ThreadPoolExecutor:
+        # The pool is sized at the retune CEILING; the effective width is
+        # the in-flight window (config.decode_threads), consulted per
+        # member — so the tuner can widen/narrow decode mid-stream without
+        # rebuilding the pool.
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.config.max_decode_threads,
+                thread_name_prefix="keystone-decode",
+                initializer=self._register_worker,
+            )
+        return self._pool
+
+    def _ensure_proc_pool(self) -> _ProcessDecodePool:
+        if self._proc_pool is None:
+            with trace.span(
+                "ingest.spawn_decode_procs", cat="ingest",
+                procs=self.config.decode_procs,
+            ):
+                self._proc_pool = _ProcessDecodePool(
+                    self.config.decode_procs, self.stats
+                )
+            _logger.info(
+                "process decode backend: %d spawned worker(s)",
+                self.config.decode_procs,
+            )
+        return self._proc_pool
+
+    def _submit_decode(self, name: str, data: bytes):
+        """Submit one member's decode on the CURRENTLY configured backend
+        (consulted per member: the autotuner may promote a running stream
+        from thread to process decode; mixed futures drain through the same
+        in-order FIFO window).  On the thread backend, when tracing is
+        enabled each decode becomes an ``ingest.decode`` span on ITS worker
+        thread's timeline — the parallel decode lanes are visible next to
+        the consumer lane, so decode/featurize overlap is a picture, not an
+        inference.  The module attribute is resolved at call time (the
+        chaos harness patches ``image_loaders.decode_image``)."""
+        if self.config.decode_backend == "process":
+            return self._ensure_proc_pool().submit(name, data)
+        pool = self._ensure_thread_pool()
         if not trace.enabled():
             return pool.submit(image_loaders.decode_image, data)
 
@@ -451,104 +924,306 @@ class IngestStream:
         return pool.submit(traced)
 
     def _produce(self):
-        # The pool is sized at the retune CEILING; the effective width is
-        # the in-flight window (config.decode_threads), consulted per
-        # member — so the tuner can widen/narrow decode mid-stream without
-        # rebuilding the pool.
-        pool = ThreadPoolExecutor(
-            max_workers=self.config.max_decode_threads,
-            thread_name_prefix="keystone-decode",
-            initializer=self._register_worker,
-        )
         clean = False
         try:
-            # Build/load the native decoder before the pool spins up (the
-            # one-time g++ build runs under native_decode's module lock and
-            # would otherwise stall every worker behind the first decode).
-            from ..loaders.native_decode import available as _native_available
+            clean = self._run_producer()
+        except BaseException as e:  # noqa: BLE001 — surfaces on the consumer
+            self._ring.fail(e)
+        finally:
+            self._ring.close()
+            if self._writer is not None:
+                # No-op after a successful commit; a cancelled/failed pass
+                # must never leave a partial snapshot behind.
+                self._writer.abort()
+            # A stopped stream may hold a hung decode future: abandon it
+            # (workers are daemon threads) instead of blocking shutdown.
+            if self._pool is not None:
+                self._pool.shutdown(wait=clean, cancel_futures=not clean)
+            if self._proc_pool is not None:
+                self._proc_pool.shutdown(clean)
 
-            _native_available()
-            # shape -> (ordinals, names, images); insertion-ordered so the
-            # end-of-stream flush of partial buckets is deterministic.
-            buckets: dict = {}
-            window: collections.deque = collections.deque()
-            ordinal = 0
+    def _snapshot_plan(self):
+        """``(root, key)`` when the decoded-chunk snapshot cache applies to
+        this stream (``snapshot_mode="featurized"`` is the workload
+        helpers' business — the ring only ever carries decoded chunks)."""
+        cfg = self.config
+        if not cfg.snapshot_dir or cfg.snapshot_mode != "decoded":
+            return None
+        if self._keep is not None and cfg.snapshot_extra is None:
+            _logger.warning(
+                "snapshot cache disabled for %s: the stream has a keep "
+                "filter but no snapshot_extra key material — an unkeyed "
+                "filter would alias different member subsets",
+                self._path,
+            )
+            return None
+        key = ksnap.snapshot_key(
+            self._path,
+            batch_size=self._batch_size,
+            mode="decoded",
+            extra=cfg.snapshot_extra,
+        )
+        return cfg.snapshot_dir, key
 
-            def drain_one():
-                nonlocal ordinal
-                name, fut = window.popleft()
-                img = self._await_decode(fut)
-                if img is None:
-                    counters.record("corrupt_image", name)
-                    self.stats.skipped += 1
-                    return
-                self.stats.decoded += 1
-                key = img.shape[:2]
-                idx, names, imgs = buckets.setdefault(key, ([], [], []))
-                idx.append(ordinal)
-                names.append(name)
-                imgs.append(img)
-                ordinal += 1
-                if len(imgs) >= self._batch_size:
-                    self._emit(buckets.pop(key))
-
-            with trace.span(
-                "ingest.produce", cat="ingest", path=self._path
-            ) as prod_sp:
+    def _run_producer(self) -> bool:
+        """Produce chunks — from the snapshot cache when a valid one
+        exists, else by live decode (teeing a fresh snapshot when caching
+        is on).  Returns True on clean end-of-stream, False when the
+        consumer cancelled."""
+        plan = self._snapshot_plan()
+        skip = 0
+        if plan is not None:
+            root, key = plan
+            snap, reason = ksnap.lookup(root, key, tar_path=self._path)
+            if reason == "stale":
+                counters.record(
+                    "snapshot_stale",
+                    f"{self._path}: committed snapshot exists under a "
+                    "different key (input or decode config moved) — live "
+                    "decode, fresh snapshot written",
+                )
+            if snap is not None:
                 try:
-                    for name, data in image_loaders._iter_tar_members(
-                        self._path
-                    ):
-                        if self._ring.stopped:
-                            raise _Cancelled()
-                        if self._keep is not None and not self._keep(name):
-                            continue
-                        window.append(
-                            (name, self._submit_decode(pool, name, data))
-                        )
-                        # Live window limit: a retune takes effect at the
-                        # next member ("while" drains DOWN to a narrowed
-                        # window; completion order through the FIFO window
-                        # is unchanged by any width).
-                        while len(window) >= self.config.window():
-                            drain_one()
-                    while window:
-                        drain_one()
-                    # Flush the batch-size remainders (partial last batch
-                    # per shape), oldest bucket first for a deterministic
-                    # tail order.
-                    for bucket in sorted(
-                        buckets.values(), key=lambda b: b[0][0]
-                    ):
-                        self._emit(bucket)
-                    clean = True
+                    emitted = self._emit_from_snapshot(snap)
                 except _Cancelled:
-                    # Consumer stopped the stream early — routine shutdown
-                    # (a supported path), not a producer failure: the span
-                    # marks it aborted rather than errored.
-                    prod_sp.set(aborted=True)
+                    return False
+                if emitted is True:
+                    return True
+                # Corrupt shard mid-read: the chunks already emitted were
+                # hash-validated (bit-equal to live decode by construction);
+                # re-decode from the top, suppressing re-emission of that
+                # prefix, and REWRITE the snapshot (self-healing).
+                skip = emitted
+                counters.record(
+                    "snapshot_fallback",
+                    f"{snap.path}: corrupt shard after {skip} chunk(s) — "
+                    "falling back to live decode (bit-equal), rewriting",
+                )
+                trace.instant(
+                    "snapshot_fallback", path=snap.path, emitted=skip
+                )
+            try:
+                self._writer = ksnap.SnapshotWriter(
+                    root,
+                    key,
+                    mode="decoded",
+                    meta={
+                        "tar": ksnap.tar_identity(self._path),
+                        "path": self._path,
+                        "batch_size": self._batch_size,
+                        "extra": self.config.snapshot_extra,
+                    },
+                )
+            except (OSError, ksnap.SnapshotError) as e:
+                # Same contract as the add_chunk tee: an unusable snapshot
+                # root (unwritable, component is a file) must never kill a
+                # healthy live-decode stream — counted, cache skipped.
+                counters.record(
+                    "snapshot_write_failed",
+                    f"{self._path}: cannot open snapshot writer: {e}",
+                )
+        try:
+            self._produce_live(skip)
+        except _Cancelled:
+            return False
+        if self._writer is not None:
+            try:
+                self._writer.commit()
+            except (OSError, ksnap.SnapshotError) as e:
+                # Every chunk already reached the consumer — a failed
+                # commit (ENOSPC, a concurrent writer racing os.replace)
+                # loses only the CACHE, never the stream.
+                counters.record(
+                    "snapshot_write_failed",
+                    f"{self._path}: commit failed: {e}",
+                )
+                self._writer.abort()
+        return True
+
+    def _emit_from_snapshot(self, snap) -> bool | int:
+        """Stream a committed snapshot's chunks into the ring.  Returns
+        True when the whole snapshot streamed, or the count of chunks
+        already emitted when a corrupt shard forces the live-decode
+        fallback."""
+        emitted = 0
+        images = 0
+        served: list = []
+        with trace.span(
+            "ingest.snapshot_read", cat="ingest",
+            path=snap.path, chunks=len(snap.manifest["chunks"]),
+        ) as sp:
+            try:
+                for _entry, arrays in snap.iter_chunks():
+                    if self._ring.stopped:
+                        raise _Cancelled()
+                    chunk = StreamBatch(
+                        index=self._chunk_counter,
+                        indices=np.asarray(arrays["indices"], np.int64),
+                        names=[str(n) for n in arrays["names"].tolist()],
+                        host=arrays["payload"],
+                    )
+                    self._chunk_counter += 1
+                    with trace.span(
+                        "ingest.ring_put", cat="ingest",
+                        index=chunk.index, images=len(chunk),
+                    ):
+                        ok = self._ring.put(chunk)
+                    if not ok:
+                        raise _Cancelled()
+                    self.stats.batches += 1
+                    self.stats.decoded += len(chunk)
+                    self.stats.snapshot_chunks_read += 1
+                    emitted += 1
+                    images += len(chunk)
+                    served.append((chunk.names, chunk.indices))
+            except ksnap.SnapshotCorrupt as e:
+                sp.set(fallback_after=emitted, corrupt=str(e)[:200])
+                # The live fallback re-decodes (and re-counts) everything
+                # from the top; un-count the snapshot prefix so stats stay
+                # one-pass truthful.  Chunk numbering restarts with it.
+                self.stats.decoded -= images
+                self._chunk_counter = 0
+                self._served_prefix = served
+                return emitted
+            sp.set(chunks_read=emitted, images=images)
+        return True
+
+    def _produce_live(self, skip_chunks: int = 0):
+        self._skip_chunks = skip_chunks
+        # Build/load the native decoder before any pool spins up (the
+        # one-time g++ build runs under native_decode's module lock and
+        # would otherwise stall every worker behind the first decode).
+        from ..loaders.native_decode import available as _native_available
+
+        _native_available()
+        # shape -> (ordinals, names, images); insertion-ordered so the
+        # end-of-stream flush of partial buckets is deterministic.
+        buckets: dict = {}
+        window: collections.deque = collections.deque()
+        ordinal = 0
+
+        def drain_one():
+            nonlocal ordinal
+            name, fut = window.popleft()
+            img = self._await_decode(fut)
+            if img is None:
+                # "corrupt_image" for an undecodable member; the process
+                # backend may instead report "decode_worker_lost" (a task
+                # that kept killing its workers) — either way a COUNTED
+                # skip, never a silent drop.
+                counters.record(
+                    getattr(fut, "skip_reason", None) or "corrupt_image",
+                    name,
+                )
+                self.stats.skipped += 1
+                return
+            self.stats.decoded += 1
+            key = img.shape[:2]
+            idx, names, imgs = buckets.setdefault(key, ([], [], []))
+            idx.append(ordinal)
+            names.append(name)
+            imgs.append(img)
+            ordinal += 1
+            if len(imgs) >= self._batch_size:
+                self._emit(buckets.pop(key))
+
+        with trace.span(
+            "ingest.produce", cat="ingest", path=self._path
+        ) as prod_sp:
+            try:
+                for name, data in image_loaders._iter_tar_members(
+                    self._path
+                ):
+                    if self._ring.stopped:
+                        raise _Cancelled()
+                    if self._keep is not None and not self._keep(name):
+                        continue
+                    window.append((name, self._submit_decode(name, data)))
+                    # Live window limit: a retune takes effect at the
+                    # next member ("while" drains DOWN to a narrowed
+                    # window; completion order through the FIFO window
+                    # is unchanged by any width).
+                    while len(window) >= self.config.window():
+                        drain_one()
+                while window:
+                    drain_one()
+                # Flush the batch-size remainders (partial last batch
+                # per shape), oldest bucket first for a deterministic
+                # tail order.
+                for bucket in sorted(
+                    buckets.values(), key=lambda b: b[0][0]
+                ):
+                    self._emit(bucket)
+            except _Cancelled:
+                # Consumer stopped the stream early — routine shutdown
+                # (a supported path), not a producer failure: the span
+                # marks it aborted rather than errored.
+                prod_sp.set(aborted=True)
+                raise
+            finally:
                 prod_sp.set(
                     decoded=self.stats.decoded,
                     skipped=self.stats.skipped,
                     batches=self.stats.batches,
                 )
-        except BaseException as e:  # noqa: BLE001 — surfaces on the consumer
-            self._ring.fail(e)
-        finally:
-            self._ring.close()
-            # A stopped stream may hold a hung decode future: abandon it
-            # (workers are daemon threads) instead of blocking shutdown.
-            pool.shutdown(wait=clean, cancel_futures=not clean)
 
     def _emit(self, bucket):
         idx, names, imgs = bucket
+        # np.stack copies straight out of any shared-memory views (the
+        # process backend's zero-extra-copy path into chunk assembly);
+        # the blocks are released the moment the chunk owns the pixels.
+        host = np.stack(
+            [i.arr if isinstance(i, _ShmArray) else i for i in imgs]
+        )
+        for i in imgs:
+            if isinstance(i, _ShmArray):
+                i.release()
         chunk = StreamBatch(
             index=self._chunk_counter,
             indices=np.asarray(idx, np.int64),
             names=names,
-            host=np.stack(imgs),
+            host=host,
         )
         self._chunk_counter += 1
+        if self._writer is not None:
+            try:
+                self._writer.add_chunk(
+                    chunk.index, chunk.indices, chunk.names, chunk.host
+                )
+                self.stats.snapshot_chunks_written += 1
+            except (OSError, ksnap.SnapshotError) as e:
+                # The cache is an optimization: a full disk (or any shard
+                # write failure) must never kill a healthy live-decode
+                # stream — counted, writer dropped, pass continues.
+                counters.record(
+                    "snapshot_write_failed", f"{self._path}: {e}"
+                )
+                self._writer.abort()
+                self._writer = None
+        if chunk.index < self._skip_chunks:
+            # Fallback re-decode: this prefix already streamed from the
+            # snapshot (hash-validated) — rewritten above, not re-emitted.
+            # Suppression is only sound while the re-decode reproduces the
+            # served chunks EXACTLY; a transient counted skip in either
+            # pass shifts every later chunk boundary, so verify before
+            # dropping (the consumer scatters rows by these ordinals —
+            # a divergence here would silently scramble them).
+            names, indices = self._served_prefix[chunk.index]
+            if chunk.names != names or not np.array_equal(
+                chunk.indices, indices
+            ):
+                counters.record(
+                    "snapshot_fallback_divergence",
+                    f"{self._path}: live re-decode chunk {chunk.index} != "
+                    "snapshot prefix already served",
+                )
+                raise SnapshotFallbackDivergence(
+                    f"{self._path}: chunk {chunk.index} of the fallback "
+                    "re-decode does not match the snapshot prefix the "
+                    "consumer already received (survivor sequences "
+                    "diverged — see the counted skip that shifted them)"
+                )
+            return
         # The put span's duration IS the backpressure stall: a full ring
         # blocks here, and the trace shows the producer lane waiting.
         with trace.span(
@@ -634,14 +1309,22 @@ class IngestStream:
         self._ring.stop()
 
     def join(self, timeout: float = 10.0) -> bool:
-        """Wait for the producer and every decoder thread to exit; returns
-        True when no ingest thread remains alive (the no-leak assertion the
-        tier-1 suite runs under pytest)."""
+        """Wait for the producer, every decoder thread, AND every decode
+        worker process to exit; returns True when no ingest thread or
+        process remains alive (the no-leak assertion the tier-1 suite runs
+        under pytest)."""
         end = time.monotonic() + timeout
         self._thread.join(max(0.0, end - time.monotonic()))
         for t in list(self._workers):
             t.join(max(0.0, end - time.monotonic()))
-        return not (
+        procs_ok = True
+        if self._proc_pool is not None:
+            while (
+                not self._proc_pool.joined() and time.monotonic() < end
+            ):
+                time.sleep(_POLL_SECONDS / 5)
+            procs_ok = self._proc_pool.joined()
+        return procs_ok and not (
             self._thread.is_alive()
             or any(t.is_alive() for t in self._workers)
         )

@@ -412,6 +412,109 @@ def release_caches(pipeline: Pipeline) -> None:
             n.clear_memo()
 
 
+# -- the snapshot advisor -----------------------------------------------------
+
+#: env var: assumed snapshot-disk sequential bandwidth (GB/s) used by the
+#: advisor when no measured rate is supplied.
+SNAPSHOT_GBPS_ENV = "KEYSTONE_SNAPSHOT_GBPS"
+_DEFAULT_SNAPSHOT_GBPS = 0.5
+
+
+def snapshot_gbps() -> float:
+    raw = os.environ.get(SNAPSHOT_GBPS_ENV, "").strip()
+    if not raw:
+        return _DEFAULT_SNAPSHOT_GBPS
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{SNAPSHOT_GBPS_ENV}={raw!r} is not a number"
+        ) from None
+    if val <= 0:
+        raise ValueError(f"{SNAPSHOT_GBPS_ENV}={raw!r} must be > 0")
+    return val
+
+
+@dataclasses.dataclass
+class SnapshotAdvice:
+    """The snapshot advisor's decision row (CachePlan's sibling): should a
+    repeat-epoch workload materialize decoded chunks instead of re-decoding
+    every epoch?  Same cost-model shape as the caching inequality — decode
+    seconds saved across repeat epochs vs the IO cost of writing once and
+    reading per epoch."""
+
+    images: int
+    epochs: int
+    bytes_per_image: int
+    decode_images_per_sec: float
+    gbps: float
+    live_seconds: float  #: epochs x one full decode
+    snapshot_seconds: float  #: decode once + write once + read (epochs-1)x
+    advise: bool
+    reason: str
+
+    def record(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["live_seconds"] = round(self.live_seconds, 3)
+        out["snapshot_seconds"] = round(self.snapshot_seconds, 3)
+        return out
+
+
+def advise_snapshot(
+    *,
+    images: int,
+    bytes_per_image: int,
+    decode_images_per_sec: float,
+    epochs: int,
+    gbps: float | None = None,
+) -> SnapshotAdvice:
+    """Cost-based snapshot decision: a snapshot pays when the decode time
+    it removes from epochs 2..N exceeds the one-time shard write plus the
+    per-epoch shard read.  ``decode_images_per_sec`` is the MEASURED live
+    decode rate (bench's decode ceiling, or the stream's own stats);
+    ``gbps`` prices shard IO (``KEYSTONE_SNAPSHOT_GBPS``)."""
+    if images < 0 or epochs < 1 or decode_images_per_sec <= 0:
+        raise ValueError(
+            "advise_snapshot wants images >= 0, epochs >= 1, "
+            "decode_images_per_sec > 0"
+        )
+    rate = gbps if gbps is not None else snapshot_gbps()
+    decode_secs = images / decode_images_per_sec
+    io_secs = images * bytes_per_image / (rate * 2**30)
+    live = epochs * decode_secs
+    snap = decode_secs + io_secs + (epochs - 1) * io_secs
+    advise = epochs > 1 and snap < live
+    if epochs <= 1:
+        reason = "single pass: nothing to amortize"
+    elif advise:
+        reason = (
+            f"snapshot {snap:.2f}s < live {live:.2f}s over {epochs} epochs "
+            f"(decode {decode_secs:.2f}s/epoch, shard IO {io_secs:.2f}s @ "
+            f"{rate}GB/s)"
+        )
+    else:
+        reason = (
+            f"live {live:.2f}s <= snapshot {snap:.2f}s — shard IO would "
+            "cost more than the decode it saves"
+        )
+    out = SnapshotAdvice(
+        images=images,
+        epochs=epochs,
+        bytes_per_image=bytes_per_image,
+        decode_images_per_sec=decode_images_per_sec,
+        gbps=rate,
+        live_seconds=live,
+        snapshot_seconds=snap,
+        advise=advise,
+        reason=reason,
+    )
+    trace.instant(
+        "snapshot_advice", advise=advise, live_seconds=round(live, 3),
+        snapshot_seconds=round(snap, 3), epochs=epochs,
+    )
+    return out
+
+
 # -- the closed-loop ingest autotuner -----------------------------------------
 
 
@@ -434,6 +537,11 @@ class IngestAutotuner:
       width is stolen featurize time) and deepen the ring (up to the cap)
       to absorb burstiness.
     * both (or neither) moved -> mixed/converged: leave the knobs alone.
+    * decode-bound AND the last decode-width doubling bought <
+      :attr:`SCALING_FLOOR` (1.3x) chunk throughput -> the pool is
+      GIL-bound, not core-bound: promote ``decode_backend`` to
+      ``process`` (the stream spins up the spawned shared-memory decode
+      pool at its next member), counted ``ingest_backend_promotions``.
 
     Every retune is appended to :attr:`trajectory`, counted
     (``ingest_retunes``), and emitted as an ``ingest_autotune`` trace
@@ -442,6 +550,11 @@ class IngestAutotuner:
     stream's own invariant.
     """
 
+    #: Threaded decode scaling below this after a width doubling reads as
+    #: "the GIL is the wall, not core count" — the knob that helps is the
+    #: BACKEND, not more width (ISSUE 7: BENCH_r05 measured 1.04x).
+    SCALING_FLOOR = 1.3
+
     def __init__(
         self,
         *,
@@ -449,11 +562,13 @@ class IngestAutotuner:
         min_threads: int = 1,
         max_ring: int = 64,
         max_ahead: int = 64,
+        allow_backend_switch: bool = True,
     ):
         self._interval = interval
         self._min_threads = min_threads
         self._max_ring = max_ring
         self._max_ahead = max_ahead
+        self._allow_backend_switch = allow_backend_switch
         self.trajectory: list = []
         self._chunks = 0
         self._last_prod = 0
@@ -461,6 +576,20 @@ class IngestAutotuner:
         self._warmed = False
         self._cfg = None
         self._stats = None
+        self._last_decide_t: float | None = None
+        self._last_interval_chunks = 0
+        #: rate (chunks/sec) measured over the interval BEFORE the last
+        #: decode-width doubling — the denominator of the scaling check.
+        self._widen_rate: float | None = None
+        #: actual width ratio of the widen behind _widen_rate (a widen
+        #: capped by max_decode_threads may be far less than a doubling —
+        #: the promotion floor must scale with what was really promised)
+        self._widen_ratio: float | None = None
+
+    def _now(self) -> float:  # seam for tests
+        import time
+
+        return time.monotonic()
 
     def attach(self, stream) -> None:
         self._cfg = stream.config
@@ -481,6 +610,14 @@ class IngestAutotuner:
         dc = st.consumer_stalls - self._last_cons
         self._last_prod = st.producer_stalls
         self._last_cons = st.consumer_stalls
+        now = self._now()
+        rate = None
+        if self._last_decide_t is not None and now > self._last_decide_t:
+            rate = (self._chunks - self._last_interval_chunks) / (
+                now - self._last_decide_t
+            )
+        self._last_decide_t = now
+        self._last_interval_chunks = self._chunks
         if not self._warmed:
             # The first interval always contains the warm-up stall: the
             # consumer's first ring.get precedes any decoded chunk, so a
@@ -491,7 +628,7 @@ class IngestAutotuner:
             return
         changes: dict = {}
 
-        def move(knob: str, new: int) -> None:
+        def move(knob: str, new) -> None:
             old = getattr(cfg, knob)
             if new != old:
                 setattr(cfg, knob, new)
@@ -499,14 +636,66 @@ class IngestAutotuner:
 
         if dc > 0 and dp == 0:
             # Decode-bound: the consumer found the ring empty this interval.
-            move(
-                "decode_threads",
-                min(cfg.max_decode_threads, cfg.decode_threads * 2),
+            # The floor scales with the width ratio actually widened: a
+            # full doubling promises SCALING_FLOOR (1.3x); a ceiling-capped
+            # 7->8 widen only promises ~1.13x even core-bound — holding it
+            # to 1.3x would misread linear scaling as GIL-bound.
+            floor = (
+                1.0 + (self.SCALING_FLOOR - 1.0) * (self._widen_ratio - 1.0)
+                if self._widen_ratio is not None
+                else None
             )
-            move(
-                "decode_ahead",
-                min(self._max_ahead, max(cfg.decode_ahead, cfg.decode_threads)),
-            )
+            if (
+                self._widen_rate is not None
+                and floor is not None
+                and rate is not None
+                and rate / self._widen_rate < floor
+                and cfg.decode_backend == "thread"
+                and self._allow_backend_switch
+            ):
+                # A doubling of decode width bought <1.3x — the thread pool
+                # is GIL-bound, not core-bound.  Promote the BACKEND: the
+                # stream lazily spins up the spawned-process pool at its
+                # next member submit.  The pool width must track the TUNED
+                # decode width, not the (possibly starved) initial
+                # decode_procs resolution — and it must land BEFORE the
+                # backend flip: the producer thread polls the config per
+                # member, and flipping first could race it into spawning
+                # a 1-worker "parallel" pool that is never resized.
+                move(
+                    "decode_procs",
+                    max(cfg.decode_procs, cfg.decode_threads),
+                )
+                move("decode_backend", "process")
+                trace.metrics.inc("ingest_backend_promotions")
+                self._widen_rate = None
+                self._widen_ratio = None
+            else:
+                old_width = cfg.decode_threads
+                move(
+                    "decode_threads",
+                    min(cfg.max_decode_threads, cfg.decode_threads * 2),
+                )
+                move(
+                    "decode_ahead",
+                    min(
+                        self._max_ahead,
+                        max(cfg.decode_ahead, cfg.decode_threads),
+                    ),
+                )
+                if "decode_threads" in changes:
+                    # Remember the pre-widen rate AND how much wider the
+                    # pool really got: the NEXT decode-bound interval's
+                    # rate over it is the measured scaling.
+                    self._widen_rate = rate
+                    self._widen_ratio = cfg.decode_threads / old_width
+                elif cfg.decode_threads == cfg.max_decode_threads == old_width:
+                    # Already at the width ceiling and still starved: treat
+                    # the flatline as scaling evidence too (a capped pool
+                    # can never demonstrate a doubling — hold it to the
+                    # full-doubling floor so a flat rate reads GIL-bound).
+                    self._widen_rate = self._widen_rate or rate
+                    self._widen_ratio = self._widen_ratio or 2.0
         elif dp > 0 and dc == 0:
             # Consumer-bound: the producer blocked on a full ring.
             move(
@@ -514,6 +703,16 @@ class IngestAutotuner:
                 max(self._min_threads, cfg.decode_threads - 1),
             )
             move("ring_capacity", min(self._max_ring, cfg.ring_capacity * 2))
+            self._widen_rate = None
+            self._widen_ratio = None
+        else:
+            # Converged or mixed interval: the pre-widen rate is no longer
+            # comparable evidence (chunk mix and load drift between
+            # decode-bound episodes) — a promotion must be argued from
+            # CONSECUTIVE decode-bound intervals, never a rate measured
+            # many intervals ago.
+            self._widen_rate = None
+            self._widen_ratio = None
         if not changes:
             return
         entry = {

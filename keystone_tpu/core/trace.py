@@ -234,6 +234,31 @@ def span(name: str, cat: str = "span", **attrs):
     return Span(name, cat, attrs)
 
 
+class _IOSpan(Span):
+    """A span over a byte-moving operation (snapshot shard IO, shared-memory
+    IPC): records ``bytes`` up front and derives ``mb_per_s`` at exit, so
+    the trace answers "was this transfer bandwidth-bound?" without
+    cross-referencing durations by hand."""
+
+    __slots__ = ()
+
+    def __exit__(self, etype, exc, tb):
+        dur_s = (_now_us() - self.t0) / 1e6
+        nbytes = self.attrs.get("bytes", 0)
+        if dur_s > 0 and nbytes:
+            self.attrs["mb_per_s"] = round(nbytes / dur_s / 1e6, 1)
+        return super().__exit__(etype, exc, tb)
+
+
+def io_span(name: str, nbytes: int, cat: str = "io", **attrs):
+    """Span for an IO/IPC transfer of ``nbytes`` — like :func:`span`, plus
+    achieved-bandwidth accounting (``bytes`` + ``mb_per_s`` attrs)."""
+    if not _enabled:
+        return _NULL
+    attrs["bytes"] = int(nbytes)
+    return _IOSpan(name, cat, attrs)
+
+
 def instant(name: str, **attrs) -> None:
     """Point event (admission decision, fault count) on the current
     thread's timeline.

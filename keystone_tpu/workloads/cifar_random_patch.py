@@ -35,7 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import optimize, trace
-from ..core.ingest import StreamConfig, stream_batches
+from ..core import snapshot as ksnap
+from ..core.ingest import stream_batches
 from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
 from ..core.pipeline import FunctionTransformer, Pipeline
@@ -56,6 +57,7 @@ from ..parallel.mesh import parse_mesh, row_sharding
 from ..solvers.block import BlockLeastSquaresEstimator
 from ..solvers.whitening import ZCAWhitenerEstimator
 from ..utils.stats import normalize_rows
+from .fv_common import stream_config_from_flags, stream_features_snapshot
 
 
 @dataclass
@@ -97,6 +99,16 @@ class RandomCifarConfig:
     #: decode width / ring depth / decode-ahead mid-stream from live stall
     #: metrics (results carry the knob trajectory).
     auto_tune: bool = False
+    #: Decode backend for the streamed test tar: "thread" / "process"
+    #: (true-parallel spawned decode workers + shared memory); None defers
+    #: to ``KEYSTONE_DECODE_BACKEND``.
+    decode_backend: str | None = None
+    #: Snapshot cache root for the streamed test tar (core.snapshot): the
+    #: first pass materializes decoded chunks — or, with
+    #: ``KEYSTONE_SNAPSHOT_MODE=featurized``, the conv FEATURES keyed by
+    #: the fitted featurizer's digest — and repeat runs stream the shards
+    #: at IO speed.  None defers to ``KEYSTONE_SNAPSHOT_DIR``.
+    snapshot_dir: str | None = None
 
 
 class _Log(Logging):
@@ -226,6 +238,24 @@ def cifar_tar_loader(path: str) -> LabeledImageBatch:
     )
 
 
+def _pad_to_chunk(batch, chunk: int):
+    """One streamed batch padded up to the compiled ``chunk`` rows (the
+    jitted featurizer has exactly one shape) — THE single implementation
+    of the compiled-chunk contract for the streaming paths."""
+    pad = chunk - batch.host.shape[0]
+    if pad > 0:
+        return jnp.asarray(
+            np.pad(batch.host, ((0, pad), (0, 0), (0, 0), (0, 0)))
+        )
+    if pad < 0:
+        raise ValueError(
+            f"streamed batch of {batch.host.shape[0]} rows exceeds the "
+            f"compiled featurize chunk {chunk} — stream with "
+            "batch_size == featurize_chunk"
+        )
+    return batch.dev()
+
+
 def featurize_stream(fn, stream, chunk: int) -> tuple[np.ndarray, list]:
     """Streaming counterpart of :func:`featurize_chunked`: consume
     batch-assembled device chunks from ``core.ingest`` — the decode of
@@ -233,35 +263,18 @@ def featurize_stream(fn, stream, chunk: int) -> tuple[np.ndarray, list]:
     while the jitted featurizer runs chunk *i* — padding each chunk to the
     compiled ``chunk`` rows.  The host sync lands only on the consumed
     chunk's features.  Returns features scattered back to stream-ordinal
-    order plus the member names in that order."""
-    parts, name_pairs, n = [], [], 0
-    for batch in stream:
-        pad = chunk - batch.host.shape[0]
-        if pad > 0:
-            dev = jnp.asarray(
-                np.pad(batch.host, ((0, pad), (0, 0), (0, 0), (0, 0)))
-            )
-        elif pad < 0:
-            raise ValueError(
-                f"streamed batch of {batch.host.shape[0]} rows exceeds the "
-                f"compiled featurize chunk {chunk} — stream with "
-                "batch_size == featurize_chunk"
-            )
-        else:
-            dev = batch.dev()
-        feats = fn(dev)
-        parts.append((batch.indices, np.asarray(feats)[: len(batch)]))
-        name_pairs.extend(zip(batch.indices.tolist(), batch.names))
-        n += len(batch)
-    if not parts:
-        return np.zeros((0, 0), np.float32), []
-    out = np.zeros((n, parts[0][1].shape[1]), np.float32)
-    names = [None] * n
-    for idx, feats in parts:
-        out[idx] = feats
-    for i, name in name_pairs:
-        names[i] = name
-    return out, names
+    order plus the member names in that order.
+
+    Delegates to :func:`~.fv_common.stream_features_snapshot`'s live pass
+    (no snapshot root), the same loop ``run()`` drives — the streamed
+    compiled-chunk contract has exactly one implementation."""
+    import contextlib
+
+    feats, names, _ = stream_features_snapshot(
+        lambda: contextlib.nullcontext(stream),
+        lambda batch: np.asarray(fn(_pad_to_chunk(batch, chunk))),
+    )
+    return feats, names
 
 
 def run(
@@ -380,19 +393,50 @@ def run(
         if conf.stream_test_tar is not None:
             # Streaming ingest: JPEG decode of the next chunk overlaps the
             # conv featurize of the current one (core.ingest ring buffer +
-            # double-buffered H2D); labels ride in the member names.
-            stream_cfg = (
-                StreamConfig.from_env(autotune=True)
-                if conf.auto_tune
-                else None
+            # double-buffered H2D); labels ride in the member names.  The
+            # config carries the decode backend and snapshot knobs
+            # (flags override the KEYSTONE_* env defaults).
+            stream_cfg = stream_config_from_flags(
+                autotune=conf.auto_tune,
+                decode_backend=conf.decode_backend,
+                snapshot_dir=conf.snapshot_dir,
+                # this path wraps the stream in stream_features_snapshot,
+                # so mode=featurized is honored rather than degraded
+                supports_featurized=True,
             )
-            with stream_batches(
-                conf.stream_test_tar, conf.featurize_chunk, config=stream_cfg
-            ) as st:
-                test_feats, names = featurize_stream(
-                    feat_fn, st, conf.featurize_chunk
+            chunk = conf.featurize_chunk
+
+            def conv_per_batch(batch):
+                return np.asarray(
+                    feat_fn(_pad_to_chunk(batch, chunk))
+                )[: len(batch)]
+
+            snap_root = snap_key = None
+            if (
+                stream_cfg.snapshot_dir
+                and stream_cfg.snapshot_mode == "featurized"
+            ):
+                # Featurized snapshot: keyed by the fitted conv pipeline's
+                # checkpoint digest — new filters/whitener = new key, so a
+                # refit can never replay stale features.
+                snap_root = stream_cfg.snapshot_dir
+                snap_key = ksnap.snapshot_key(
+                    conf.stream_test_tar,
+                    batch_size=chunk,
+                    mode="featurized",
+                    featurizer=ksnap.featurizer_digest(conv_pipe),
                 )
-            if st.tuner is not None:
+            test_feats, names, st = stream_features_snapshot(
+                lambda: stream_batches(
+                    conf.stream_test_tar, chunk, config=stream_cfg
+                ),
+                conv_per_batch,
+                root=snap_root,
+                key=snap_key,
+                tar_path=conf.stream_test_tar,
+                meta={"tar": ksnap.tar_identity(conf.stream_test_tar)},
+            )
+            if st is not None and st.tuner is not None:
                 results_autotune = st.tuner.record()
                 log.log_info(
                     "ingest autotune: %d retune(s), final config %s",
@@ -461,6 +505,24 @@ def main(argv=None):
         "('<label>/name.jpg' members) with decode/featurize overlap",
     )
     p.add_argument(
+        "--decodeBackend",
+        default=None,
+        choices=("thread", "process"),
+        help="decode backend for --streamTestTar: 'process' decodes on "
+        "spawned worker processes (shared-memory return path, true "
+        "parallel) instead of the GIL-bound thread pool "
+        "(KEYSTONE_DECODE_BACKEND equivalent)",
+    )
+    p.add_argument(
+        "--snapshotDir",
+        default=None,
+        help="snapshot cache root for --streamTestTar (core.snapshot): "
+        "first pass materializes decoded chunks (or conv FEATURES under "
+        "KEYSTONE_SNAPSHOT_MODE=featurized, keyed by the fitted "
+        "featurizer's digest); repeat runs stream the shards at IO speed "
+        "(KEYSTONE_SNAPSHOT_DIR equivalent)",
+    )
+    p.add_argument(
         "--mesh",
         default=None,
         help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
@@ -508,6 +570,8 @@ def main(argv=None):
         stream_test_tar=a.streamTestTar,
         auto_cache=a.autoCache or optimize.auto_cache_env(),
         auto_tune=a.autoTune,
+        decode_backend=a.decodeBackend,
+        snapshot_dir=a.snapshotDir,
     )
     if a.testLocation is None and a.streamTestTar is None:
         p.error("one of --testLocation / --streamTestTar is required")

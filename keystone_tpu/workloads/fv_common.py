@@ -158,6 +158,149 @@ def plan_pca_materialization(
 # -- streaming ingest (core.ingest) -------------------------------------------
 
 
+def stream_config_from_flags(
+    *, autotune: bool = False, decode_backend: str | None = None,
+    snapshot_dir: str | None = None, snapshot_extra: str | None = None,
+    supports_featurized: bool = False,
+):
+    """One ``StreamConfig`` builder for every streaming workload: env-seeded
+    (``KEYSTONE_*``), with the workload's ``--autoTune`` / ``--decodeBackend``
+    / ``--snapshotDir`` flags overriding the env defaults.  ``snapshot_extra``
+    keys the stream's member-selection inputs (keep filters, label files)
+    into the snapshot content hash.
+
+    ``supports_featurized``: set by callers that wrap the stream in
+    :func:`stream_features_snapshot`.  Everywhere else a
+    ``KEYSTONE_SNAPSHOT_MODE=featurized`` request degrades to DECODED
+    caching — counted (``snapshot_mode_unsupported``), never a silently
+    inert cache dir."""
+    from ..core.ingest import StreamConfig
+    from ..core.resilience import counters
+
+    cfg = StreamConfig.from_env(
+        autotune=True if autotune else None,
+        decode_backend=decode_backend,
+        snapshot_dir=snapshot_dir,
+        snapshot_extra=snapshot_extra,
+    )
+    if (
+        cfg.snapshot_dir
+        and cfg.snapshot_mode == "featurized"
+        and not supports_featurized
+    ):
+        counters.record(
+            "snapshot_mode_unsupported",
+            "featurized snapshots are not implemented on this stream — "
+            "caching decoded chunks instead",
+        )
+        cfg.snapshot_mode = "decoded"
+    return cfg
+
+
+def stream_features_snapshot(
+    make_stream, per_batch, *, root=None, key=None, tar_path=None, meta=None
+):
+    """Featurized-snapshot wrapper around a streaming featurize pass.
+
+    ``per_batch``: ``StreamBatch -> np.ndarray [b, D]`` feature rows.
+    With ``root``/``key`` set and a committed FEATURIZED snapshot present,
+    the features stream straight from the shards — no tar read, no decode,
+    no device featurize (``key`` must fold in the fitted featurizer's
+    digest, ``core.snapshot.featurizer_digest``, so refits never replay
+    stale features).  Otherwise the live pass runs (decode of chunk *i+1*
+    overlapping featurize of chunk *i*) and its per-batch features are teed
+    into a fresh snapshot, committed only on clean completion.  A corrupt
+    shard mid-read is a counted ``snapshot_fallback`` to the live pass.
+
+    Returns ``(features [n, D] f32, names, stream_or_None)`` — the stream
+    is None when the snapshot served the pass (nothing streamed, so there
+    is no autotune record)."""
+    from ..core import snapshot as ksnap
+    from ..core.resilience import counters
+
+    if root is not None and key is not None:
+        # tar_path (when given) powers the staleness classification: a
+        # committed FEATURIZED snapshot for the same tar under another key
+        # means the featurizer or input moved — counted, not silent.
+        snap, reason = ksnap.lookup(
+            root, key, tar_path=tar_path, mode="featurized"
+        )
+        if reason == "stale":
+            counters.record(
+                "snapshot_stale",
+                f"{root}: featurized snapshot keyed differently "
+                "(featurizer or input moved) — recomputing",
+            )
+        if snap is not None:
+            parts, name_pairs, n = [], [], 0
+            try:
+                for _entry, arrays in snap.iter_chunks():
+                    idx = np.asarray(arrays["indices"], np.int64)
+                    parts.append((idx, np.asarray(arrays["payload"], np.float32)))
+                    name_pairs.extend(
+                        zip(idx.tolist(), [str(x) for x in arrays["names"]])
+                    )
+                    n += len(idx)
+                feats, names = _scatter_parts(parts, name_pairs, n)
+                return feats, names, None
+            except ksnap.SnapshotCorrupt as e:
+                counters.record(
+                    "snapshot_fallback",
+                    f"{snap.path}: {e} — recomputing features live",
+                )
+
+    writer = None
+    if root is not None and key is not None:
+        meta = dict(meta or {})
+        if tar_path is not None:
+            # The manifest's tar identity is what classifies a later
+            # different-key lookup as STALE rather than a plain miss.
+            meta.setdefault("tar", ksnap.tar_identity(tar_path))
+        try:
+            writer = ksnap.SnapshotWriter(
+                root, key, mode="featurized", meta=meta
+            )
+        except (OSError, ksnap.SnapshotError) as e:
+            # An unusable snapshot root never kills the featurize pass —
+            # same counted-degrade contract as a failed shard write.
+            counters.record(
+                "snapshot_write_failed",
+                f"cannot open featurized snapshot writer: {e}",
+            )
+    parts, name_pairs, n = [], [], 0
+    try:
+        with make_stream() as st:
+            for batch in st:
+                feats = np.asarray(per_batch(batch), np.float32)[: len(batch)]
+                parts.append((batch.indices, feats))
+                name_pairs.extend(zip(batch.indices.tolist(), batch.names))
+                n += len(batch)
+                if writer is not None:
+                    try:
+                        writer.add_chunk(
+                            batch.index, batch.indices, batch.names, feats
+                        )
+                    except (OSError, ksnap.SnapshotError) as e:
+                        # Same contract as the ingest tee: the cache is an
+                        # optimization — a full disk drops the WRITER,
+                        # counted, never the featurize pass.
+                        counters.record("snapshot_write_failed", str(e))
+                        writer.abort()
+                        writer = None
+        if writer is not None:
+            try:
+                writer.commit()
+            except (OSError, ksnap.SnapshotError) as e:
+                counters.record(
+                    "snapshot_write_failed", f"commit failed: {e}"
+                )
+    finally:
+        if writer is not None:
+            writer.abort()  # no-op after commit; drops partials on error
+    feats, names = _scatter_parts(parts, name_pairs, n)
+    return feats, names, st
+
+
 def record_stream_autotune(src, stream) -> None:
     """Append a finished stream's autotuner record to its source (one
     record per streaming pass — ImageNet streams a source once per
@@ -184,6 +327,22 @@ def _ordered_names(pairs: list, n: int) -> list:
     for i, name in pairs:
         names[i] = name
     return names
+
+
+def _scatter_parts(
+    parts: list, name_pairs: list, n: int, feature_dim: int | None = None
+) -> tuple[np.ndarray, list]:
+    """Scatter accumulated ``(indices, [b, D] features)`` parts back to
+    stream-ordinal (decode-survival) order — the one copy of the
+    scatter-to-ordinal contract every streaming feature pass shares
+    (``feats[: len(idx)]`` drops sharding pad rows, see shard_batch).
+    ``feature_dim`` is inferred from the first part when omitted."""
+    if feature_dim is None:
+        feature_dim = parts[0][1].shape[1] if parts else 0
+    out = np.zeros((n, feature_dim), np.float32)
+    for idx, feats in parts:
+        out[np.asarray(idx)] = feats[: len(idx)]
+    return out, _ordered_names(name_pairs, n)
 
 
 def stream_descriptor_buckets(stream, per_batch) -> tuple[dict, list]:
@@ -245,7 +404,4 @@ def scatter_features_streaming(stream, transform, feature_dim: int) -> tuple[np.
         parts.append((batch.indices, np.asarray(feats, np.float32)))
         name_pairs.extend(zip(batch.indices.tolist(), batch.names))
         n += len(batch)
-    out = np.zeros((n, feature_dim), np.float32)
-    for idx, feats in parts:
-        out[idx] = feats[: len(idx)]
-    return out, _ordered_names(name_pairs, n)
+    return _scatter_parts(parts, name_pairs, n, feature_dim)

@@ -21,7 +21,7 @@ import numpy as np
 
 from ..core import optimize, trace
 from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
-from ..core.ingest import StreamConfig, stream_batches
+from ..core.ingest import stream_batches
 from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
 from ..core.resilience import assert_all_finite
@@ -49,6 +49,7 @@ from .fv_common import (
     sample_columns,
     scatter_features,
     shard_batch,
+    stream_config_from_flags,
     stream_descriptor_buckets,
 )
 
@@ -70,6 +71,11 @@ class ImageNetStreamSource:
     batch_size: int = 32
     #: closed-loop ingest autotuner on this source's streams (--autoTune)
     autotune: bool = False
+    #: decode backend (--decodeBackend): None defers to env
+    decode_backend: str | None = None
+    #: snapshot cache root (--snapshotDir): decoded chunks keyed by tar +
+    #: decode config + the synset filter's label-file identity
+    snapshot_dir: str | None = None
 
     def __post_init__(self):
         self._names: list | None = None
@@ -121,7 +127,19 @@ def _streaming_buckets(src: ImageNetStreamSource, per_batch) -> dict:
     def keep(name: str) -> bool:
         return name.split("/")[0] in lm
 
-    cfg = StreamConfig.from_env(autotune=True) if src.autotune else None
+    # The synset filter derives from the labels file — its identity keys
+    # the snapshot (a changed labels file changes the survivor set).
+    # Computed unconditionally (one os.stat): inert when snapshots are
+    # off, and an env-only KEYSTONE_SNAPSHOT_DIR is never silently inert.
+    from ..core import snapshot as ksnap
+
+    extra = f"imagenet:{ksnap.file_identity(src.labels_path)}"
+    cfg = stream_config_from_flags(
+        autotune=src.autotune,
+        decode_backend=src.decode_backend,
+        snapshot_dir=src.snapshot_dir,
+        snapshot_extra=extra,
+    )
     with stream_batches(
         src.data_path, src.batch_size, keep=keep, config=cfg
     ) as st:
@@ -484,6 +502,22 @@ def main(argv=None):
         "(KEYSTONE_AUTOTUNE=1 equivalent)",
     )
     p.add_argument(
+        "--decodeBackend",
+        default=None,
+        choices=("thread", "process"),
+        help="decode backend for --streamIngest: 'process' decodes on "
+        "spawned worker processes via shared memory "
+        "(KEYSTONE_DECODE_BACKEND equivalent)",
+    )
+    p.add_argument(
+        "--snapshotDir",
+        default=None,
+        help="snapshot cache root for --streamIngest streams "
+        "(core.snapshot): first pass materializes decoded chunks, repeat "
+        "runs stream the shards at IO speed "
+        "(KEYSTONE_SNAPSHOT_DIR equivalent)",
+    )
+    p.add_argument(
         "--mesh",
         default=None,
         help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
@@ -537,6 +571,7 @@ def main(argv=None):
         train = ImageNetStreamSource(
             conf.train_location, conf.label_path,
             batch_size=a.streamBatchSize, autotune=a.autoTune,
+            decode_backend=a.decodeBackend, snapshot_dir=a.snapshotDir,
         )
     else:
         train = imagenet_loader(conf.train_location, conf.label_path)
@@ -544,6 +579,7 @@ def main(argv=None):
         test = ImageNetStreamSource(
             conf.test_location, conf.label_path,
             batch_size=a.streamBatchSize, autotune=a.autoTune,
+            decode_backend=a.decodeBackend, snapshot_dir=a.snapshotDir,
         )
     else:
         test = imagenet_loader(conf.test_location, conf.label_path)

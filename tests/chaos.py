@@ -42,6 +42,14 @@ draws its parameters — fully deterministic):
   mid-stream: the typed-or-equal invariant must hold under retuning —
   streamed features bit-equal to a static-knob stream, every thread
   joined.
+* ``snapshot_corrupt`` — a truncated/bit-flipped snapshot shard under the
+  materialized decode cache (core.snapshot): the stream must fall back to
+  live decode with a counted ``snapshot_fallback`` and features
+  BIT-EQUAL to the fault-free pass — never silently stale pixels.
+* ``decode_worker_kill`` — SIGKILL of a process-backend decode worker
+  mid-stream: the pool must respawn it (counted
+  ``decode_worker_respawn``) and finish with features bit-equal to the
+  thread-path oracle — never a hung ring, never a lost image.
 """
 
 from __future__ import annotations
@@ -93,12 +101,14 @@ FAMILIES = (
     "stream_corrupt",
     "stream_hang",
     "autotune_thrash",
+    "snapshot_corrupt",
+    "decode_worker_kill",
 )
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(10))
-FULL_SEEDS = tuple(range(21))
+TIER1_SEEDS = tuple(range(12))
+FULL_SEEDS = tuple(range(24))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -203,6 +213,17 @@ def make_schedule(seed: int) -> Fault:
             kind,
             {"batch": int(rng.integers(2, 5)), "period": int(rng.integers(1, 3))},
         )
+    if kind == "snapshot_corrupt":
+        return Fault(
+            kind,
+            {
+                "batch": int(rng.integers(2, 5)),
+                "shard": int(rng.integers(0, 4)),
+                "corruption": ("truncate", "bitflip")[int(rng.integers(0, 2))],
+            },
+        )
+    if kind == "decode_worker_kill":
+        return Fault(kind, {"batch": 4, "procs": 2})
     return Fault("deadline", {"seconds": 1.0})
 
 
@@ -571,6 +592,157 @@ def _autotune_thrash_phase(fault: Fault, tmpdir: str, seed: int) -> None:
     )
 
 
+def _snapshot_corrupt_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """Corrupt snapshot shard (core.snapshot): a cold pass materializes the
+    decoded chunks, one shard is truncated/bit-flipped, and the warm pass
+    must fall back to live decode COUNTED (``snapshot_fallback``) with
+    features bit-equal to the fault-free pass — never silently stale
+    pixels."""
+    import glob as _glob
+
+    from keystone_tpu.core import snapshot as ksnap
+
+    rng = np.random.default_rng(seed)
+    tar_path = os.path.join(tmpdir, f"chaos_snap_{seed}.tar")
+    faults.make_image_tar(tar_path, _N_STREAM_IMAGES, rng)
+    snap_root = os.path.join(tmpdir, f"chaos_snap_{seed}_cache")
+    batch = int(fault.params["batch"])
+
+    def cfg():
+        # snapshot_mode pinned: an ambient KEYSTONE_SNAPSHOT_MODE=featurized
+        # would stop the ingest tee from committing a decoded snapshot and
+        # fail the family with nothing to corrupt (same hazard bench.py's
+        # no_snap() pins against).
+        return ingest.StreamConfig.from_env(
+            snapshot_dir=snap_root, snapshot_mode="decoded"
+        )
+
+    clean_feats, clean_names = _stream_featurize(tar_path, batch, config=cfg())
+    committed = [
+        s for s in ksnap.list_snapshots(snap_root) if s.get("valid")
+    ]
+    if not committed:
+        raise ChaosOracleError(
+            "cold snapshot pass committed no snapshot — the corruption "
+            "schedule has nothing to corrupt"
+        )
+    shards = sorted(
+        _glob.glob(
+            os.path.join(snap_root, committed[0]["dir"], "chunk_*.npz")
+        )
+    )
+    if not shards:
+        raise ChaosOracleError("committed snapshot holds no shards")
+    target = shards[int(fault.params["shard"]) % len(shards)]
+    with open(target, "rb") as fh:
+        data = bytearray(fh.read())
+    if fault.params["corruption"] == "truncate":
+        data = data[: max(1, len(data) // 2)]
+    else:
+        data[len(data) // 3] ^= 0xFF
+    with open(target, "wb") as fh:
+        fh.write(bytes(data))
+
+    before = counters.get("snapshot_fallback")
+    faulted_feats, faulted_names = _stream_featurize(
+        tar_path, batch, config=cfg()
+    )
+    if counters.get("snapshot_fallback") - before < 1:
+        raise ChaosOracleError(
+            "corrupt snapshot shard produced no counted snapshot_fallback "
+            "— the reader either served corrupt bytes or fell back "
+            "invisibly"
+        )
+    if faulted_names != clean_names:
+        raise ChaosOracleError(
+            "snapshot fallback lost/reordered data: "
+            f"{faulted_names} != {clean_names}"
+        )
+    if not np.array_equal(faulted_feats, clean_feats):
+        raise ChaosOracleError(
+            "features under a corrupt snapshot shard differ from live "
+            "decode — the fallback is not bit-equal"
+        )
+
+
+def _decode_worker_kill_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """SIGKILL a process-backend decode worker mid-stream: the pool must
+    respawn it (counted ``decode_worker_respawn``), resubmit its pending
+    members, and finish with features bit-equal to the thread-path oracle
+    — never a hung ring."""
+    import signal
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    tar_path = os.path.join(tmpdir, f"chaos_kill_{seed}.tar")
+    faults.make_image_tar(tar_path, _N_STREAM_IMAGES + 6, rng)
+    batch = int(fault.params["batch"])
+    clean_feats, clean_names = _stream_featurize(tar_path, batch)
+
+    feat = jax.jit(
+        lambda x: jnp.stack(
+            [jnp.mean(x, axis=(1, 2, 3)), jnp.max(x, axis=(1, 2, 3))], axis=1
+        )
+    )
+    cfg = ingest.StreamConfig(
+        decode_threads=2, decode_ahead=2, ring_capacity=1,
+        decode_backend="process", decode_procs=int(fault.params["procs"]),
+    )
+    before = counters.get("decode_worker_respawn")
+    parts, name_pairs, n = [], [], 0
+    killed = False
+    st = ingest.stream_batches(tar_path, batch, config=cfg)
+    try:
+        for b in st:
+            if not killed:
+                pool = st._proc_pool
+                if pool is None:
+                    raise ChaosOracleError(
+                        "process backend configured but no decode pool "
+                        "spun up — the kill schedule has no target"
+                    )
+                live = [w for w in pool._workers if w.proc.is_alive()]
+                if live:
+                    os.kill(live[0].proc.pid, signal.SIGKILL)
+                    killed = True
+            parts.append((b.indices, np.asarray(feat(b.dev()))))
+            name_pairs.extend(zip(b.indices.tolist(), b.names))
+            n += len(b)
+    finally:
+        st.close()
+    if not st.join(20.0):
+        raise ChaosOracleError(
+            "worker-kill stream left decode threads/processes alive"
+        )
+    if not killed:
+        raise ChaosOracleError(
+            "no live decode worker to kill — the schedule never exercised "
+            "the crash path"
+        )
+    if counters.get("decode_worker_respawn") - before < 1:
+        raise ChaosOracleError(
+            "killed decode worker was never respawned-and-counted"
+        )
+    from keystone_tpu.workloads.fv_common import _scatter_parts
+
+    feats, names = _scatter_parts(parts, name_pairs, n)
+    if names != clean_names:
+        raise ChaosOracleError(
+            f"worker kill lost/reordered data: {names} != {clean_names}"
+        )
+    if not np.array_equal(feats, clean_feats):
+        raise ChaosOracleError(
+            "features under a worker kill differ from the thread-path "
+            "oracle — process decode is not bit-equal after respawn"
+        )
+    counters.record(
+        "chaos_decode_worker_kill",
+        f"seed {seed}: worker killed, respawned, stream bit-equal",
+    )
+
+
 def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
     """Apply one schedule to the workload; returns the results dict (or
     raises).  Each branch is the minimal faithful injection for its
@@ -601,6 +773,14 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "autotune_thrash":
         _autotune_thrash_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "snapshot_corrupt":
+        _snapshot_corrupt_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "decode_worker_kill":
+        _decode_worker_kill_phase(fault, tmpdir, seed)
         return _run_workload(workload)
 
     if fault.kind == "nan_input":

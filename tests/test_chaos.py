@@ -26,6 +26,8 @@ EXPECTED_COUNTER = {
     "stream_corrupt": "corrupt_image",
     "stream_hang": "deadline_exceeded",
     "autotune_thrash": "chaos_autotune_thrash",
+    "snapshot_corrupt": "snapshot_fallback",
+    "decode_worker_kill": "decode_worker_respawn",
 }
 
 
@@ -62,6 +64,10 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # Mid-stream retune coverage (ISSUE 6): the typed-or-equal invariant
     # must be exercised under oscillating autotuner knob motion
     assert "autotune_thrash" in kinds
+    # Decode-wall coverage (ISSUE 7): corrupt snapshot shards must fall
+    # back counted-and-bit-equal, and a SIGKILLed decode worker must
+    # respawn counted — never a hung ring
+    assert {"snapshot_corrupt", "decode_worker_kill"} <= kinds
 
 
 def test_schedules_are_deterministic():

@@ -9,6 +9,7 @@ producer failures surface typed on the consumer, and a hung decoder is
 interruptible by ``resilience.deadline`` instead of deadlocking the ring.
 """
 
+import glob
 import io
 import tarfile
 import threading
@@ -301,3 +302,104 @@ def test_hung_decoder_trips_deadline_not_deadlock(tar_uniform, monkeypatch):
     # remains until its sleep ends — it must exit by then (no leak).
     assert st._thread.is_alive() is False or st.join(5.0)
     assert st.join(5.0)
+
+
+# -- the multiprocess shared-memory decode backend ----------------------------
+
+
+def _devshm_segments():
+    return set(glob.glob("/dev/shm/psm_*"))
+
+
+def test_process_backend_bit_identical_to_thread(tar_mixed):
+    """The spawned-worker backend must reproduce the thread path exactly:
+    same chunks, same ordinals, same member names, same pixels — over a
+    mixed-shape tar (bucketing exercised across the IPC boundary)."""
+    path, _ = tar_mixed
+    with ingest.stream_batches(path, 4, transfer=False) as st:
+        thread_chunks = [
+            (b.index, b.indices.copy(), list(b.names), b.host.copy())
+            for b in st
+        ]
+    assert st.join(10.0)
+    cfg = ingest.StreamConfig.from_env(
+        decode_backend="process", decode_procs=2
+    )
+    with ingest.stream_batches(path, 4, transfer=False, config=cfg) as st2:
+        proc_chunks = [
+            (b.index, b.indices.copy(), list(b.names), b.host.copy())
+            for b in st2
+        ]
+    assert st2.join(20.0), "decode worker processes leaked"
+    assert len(thread_chunks) == len(proc_chunks)
+    for a, b in zip(thread_chunks, proc_chunks):
+        assert a[0] == b[0] and a[2] == b[2]
+        assert np.array_equal(a[1], b[1])
+        assert np.array_equal(a[3], b[3])
+
+
+def test_process_backend_early_exit_leaks_no_shm(tar_uniform):
+    """Early consumer exit with worker-decoded images still in flight:
+    every shared-memory block must be released (the pool registry drains
+    and /dev/shm gains nothing) and every worker process joined."""
+    path, _ = tar_uniform
+    before = _devshm_segments()
+    cfg = ingest.StreamConfig.from_env(
+        decode_backend="process", decode_procs=2, ring_capacity=1
+    )
+    st = ingest.stream_batches(path, 2, transfer=False, config=cfg)
+    next(iter(st))  # one chunk, then bail with decodes still in flight
+    st.close()
+    assert st.join(20.0), "decode worker processes leaked"
+    assert st._proc_pool is not None
+    assert st._proc_pool._live_shm == {}
+    # allow the kernel a beat to reap unlinked names
+    for _ in range(50):
+        leaked = _devshm_segments() - before
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"leaked /dev/shm segments: {leaked}"
+
+
+def test_process_backend_corrupt_member_counted_skip(tmp_path, rng):
+    """A corrupt member decoded in a worker process honors the same
+    counted-skip contract as the thread path."""
+    path = str(tmp_path / "bad.tar")
+    names = _make_tar(path, [(48, 48)] * 6, rng, corrupt=(2,))
+    before = counters.get("corrupt_image")
+    cfg = ingest.StreamConfig.from_env(
+        decode_backend="process", decode_procs=2
+    )
+    with ingest.stream_batches(path, 3, transfer=False, config=cfg) as st:
+        got = [n for b in st for n in b.names]
+    assert st.join(20.0)
+    assert counters.get("corrupt_image") == before + 1
+    assert got == [n for i, n in enumerate(names) if i != 2]
+    assert st.stats.skipped == 1
+
+
+def test_decode_backend_env_and_validation(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_DECODE_BACKEND", "process")
+    assert ingest.StreamConfig.from_env().decode_backend == "process"
+    monkeypatch.setenv("KEYSTONE_DECODE_BACKEND", "gpu")
+    with pytest.raises(ValueError, match="KEYSTONE_DECODE_BACKEND"):
+        ingest.StreamConfig.from_env()
+    with pytest.raises(ValueError, match="decode_backend"):
+        ingest.StreamConfig(
+            decode_threads=1, decode_ahead=0, ring_capacity=1,
+            decode_backend="gpu",
+        )
+    # decode_procs resolves to the decode width when unset
+    cfg = ingest.StreamConfig(
+        decode_threads=3, decode_ahead=0, ring_capacity=1
+    )
+    assert cfg.decode_procs == 3
+    # the env knob agrees with the field on the meaning of 0 (= auto)
+    monkeypatch.setenv("KEYSTONE_DECODE_BACKEND", "thread")
+    monkeypatch.setenv("KEYSTONE_DECODE_PROCS", "0")
+    cfg = ingest.StreamConfig.from_env(decode_threads=2)
+    assert cfg.decode_procs == 2
+    monkeypatch.setenv("KEYSTONE_DECODE_PROCS", "-1")
+    with pytest.raises(ValueError, match="KEYSTONE_DECODE_PROCS"):
+        ingest.StreamConfig.from_env()

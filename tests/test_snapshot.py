@@ -1,0 +1,525 @@
+"""Materialized snapshot cache tests (core.snapshot + the ingest and
+fv_common integrations): key invalidation (tar identity, chunking, extra
+key material, featurizer digest), bit-identical warm reads, counted
+stale/corrupt fallbacks, crash-safe commit semantics, and the admin tool.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import faults
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from keystone_tpu.core import ingest
+from keystone_tpu.core import snapshot as ksnap
+from keystone_tpu.core.resilience import counters
+
+
+@pytest.fixture
+def tar10(tmp_path, rng):
+    path = str(tmp_path / "snap.tar")
+    names = faults.make_image_tar(path, 10, rng)
+    return path, names
+
+
+def _stream(path, batch, snapshot_dir=None, **kw):
+    cfg = ingest.StreamConfig.from_env(snapshot_dir=snapshot_dir, **kw)
+    out = []
+    with ingest.stream_batches(path, batch, transfer=False, config=cfg) as st:
+        for b in st:
+            out.append((b.index, b.indices.copy(), list(b.names), b.host.copy()))
+    assert st.join(10.0)
+    return out, st
+
+
+def _assert_streams_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x[0] == y[0]
+        assert np.array_equal(x[1], y[1])
+        assert x[2] == y[2]
+        assert x[3].dtype == y[3].dtype
+        assert np.array_equal(x[3], y[3])
+
+
+# -- keys ---------------------------------------------------------------------
+
+
+def test_key_is_stable_and_moves_with_inputs(tar10):
+    path, _ = tar10
+    k = ksnap.snapshot_key(path, batch_size=4)
+    assert k == ksnap.snapshot_key(path, batch_size=4)
+    # chunk layout depends on batch size -> part of the key
+    assert k != ksnap.snapshot_key(path, batch_size=8)
+    # extra key material (keep filters, label files) moves the key
+    assert k != ksnap.snapshot_key(path, batch_size=4, extra="voc:prefix")
+    # touching the tar (new mtime) invalidates
+    st = os.stat(path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 10_000_000))
+    assert k != ksnap.snapshot_key(path, batch_size=4)
+
+
+def test_featurized_key_requires_and_folds_in_digest(tar10):
+    path, _ = tar10
+    with pytest.raises(ValueError, match="featurizer"):
+        ksnap.snapshot_key(path, batch_size=4, mode="featurized")
+    ka = ksnap.snapshot_key(
+        path, batch_size=4, mode="featurized", featurizer="digest-a"
+    )
+    kb = ksnap.snapshot_key(
+        path, batch_size=4, mode="featurized", featurizer="digest-b"
+    )
+    assert ka != kb
+    # decoded vs featurized never alias even with identical inputs
+    assert ka != ksnap.snapshot_key(path, batch_size=4)
+
+
+def test_featurizer_digest_moves_with_weights():
+    from keystone_tpu.solvers.pca import BatchPCATransformer
+
+    import jax.numpy as jnp
+
+    a = ksnap.featurizer_digest(
+        BatchPCATransformer(jnp.ones((4, 2), jnp.float32))
+    )
+    b = ksnap.featurizer_digest(
+        BatchPCATransformer(jnp.full((4, 2), 2.0, jnp.float32))
+    )
+    assert a != b
+    # unserializable featurizers refuse rather than key silently
+    from keystone_tpu.core.checkpoint import CheckpointError
+
+    with pytest.raises(CheckpointError):
+        ksnap.featurizer_digest(lambda x: x)
+
+
+# -- decoded snapshots through the ingest stream ------------------------------
+
+
+def test_cold_write_then_warm_read_bit_identical(tmp_path, tar10):
+    path, _ = tar10
+    root = str(tmp_path / "cache")
+    cold, st_cold = _stream(path, 4, snapshot_dir=root)
+    assert st_cold.stats.snapshot_chunks_written == len(cold)
+    assert st_cold.stats.snapshot_chunks_read == 0
+    committed = [s for s in ksnap.list_snapshots(root) if s["valid"]]
+    assert len(committed) == 1 and committed[0]["images"] == 10
+    warm, st_warm = _stream(path, 4, snapshot_dir=root)
+    assert st_warm.stats.snapshot_chunks_read == len(cold)
+    _assert_streams_equal(cold, warm)
+
+
+def test_stale_key_is_counted_and_rewritten(tmp_path, tar10):
+    path, _ = tar10
+    root = str(tmp_path / "cache")
+    cold, _ = _stream(path, 4, snapshot_dir=root)
+    st = os.stat(path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 10_000_000))
+    before = counters.get("snapshot_stale")
+    again, st2 = _stream(path, 4, snapshot_dir=root)
+    assert counters.get("snapshot_stale") == before + 1
+    assert st2.stats.snapshot_chunks_read == 0  # stale -> live decode
+    _assert_streams_equal(cold, again)  # same bytes, so same chunks
+    # the fresh key committed alongside (or over) the stale one
+    keys = {s["key"] for s in ksnap.list_snapshots(root) if s["valid"]}
+    assert ksnap.snapshot_key(path, batch_size=4) in keys
+
+
+def test_corrupt_shard_counted_fallback_and_self_heal(tmp_path, tar10):
+    path, _ = tar10
+    root = str(tmp_path / "cache")
+    cold, _ = _stream(path, 4, snapshot_dir=root)
+    shard = sorted(glob.glob(os.path.join(root, "snap-*", "chunk_*.npz")))[1]
+    with open(shard, "rb") as fh:
+        data = fh.read()
+    with open(shard, "wb") as fh:
+        fh.write(data[: len(data) // 2])
+    before = counters.get("snapshot_fallback")
+    fb, st_fb = _stream(path, 4, snapshot_dir=root)
+    assert counters.get("snapshot_fallback") == before + 1
+    _assert_streams_equal(cold, fb)
+    # the fallback pass rewrote the snapshot: the next read is clean
+    healed, st_h = _stream(path, 4, snapshot_dir=root)
+    assert counters.get("snapshot_fallback") == before + 1
+    assert st_h.stats.snapshot_chunks_read == len(cold)
+    _assert_streams_equal(cold, healed)
+
+
+def test_fallback_divergence_is_typed_never_scrambled(
+    tmp_path, tar10, monkeypatch
+):
+    """Prefix suppression during a corrupt-shard fallback is only sound
+    while the live re-decode reproduces the served chunks exactly.  When a
+    transient counted skip shifts the survivor sequence between the two
+    passes, the stream must die TYPED (the consumer scatters rows by
+    ordinal — continuing would silently scramble them)."""
+    from keystone_tpu.loaders import image_loaders
+
+    path, names = tar10
+    root = str(tmp_path / "cache")
+    cold, _ = _stream(path, 4, snapshot_dir=root)
+    shard = sorted(glob.glob(os.path.join(root, "snap-*", "chunk_*.npz")))[1]
+    with open(shard, "rb") as fh:
+        data = fh.read()
+    with open(shard, "wb") as fh:
+        fh.write(data[: len(data) // 2])
+    # The first member (inside the already-served prefix) now fails decode
+    # — a transient counted skip that shifts every later chunk boundary.
+    target = dict(image_loaders._iter_tar_members(path))[names[0]]
+    real = image_loaders.decode_image
+
+    def flaky(data):
+        return None if data == target else real(data)
+
+    monkeypatch.setattr(image_loaders, "decode_image", flaky)
+    before = counters.get("snapshot_fallback_divergence")
+    cfg = ingest.StreamConfig.from_env(snapshot_dir=root)
+    with pytest.raises(ingest.SnapshotFallbackDivergence):
+        with ingest.stream_batches(path, 4, transfer=False, config=cfg) as st:
+            for _ in st:
+                pass
+    assert counters.get("snapshot_fallback_divergence") == before + 1
+    assert st.join(10.0)
+
+
+def test_early_consumer_exit_commits_nothing(tmp_path, tar10):
+    path, _ = tar10
+    root = str(tmp_path / "cache")
+    cfg = ingest.StreamConfig.from_env(snapshot_dir=root, ring_capacity=1)
+    with ingest.stream_batches(path, 2, transfer=False, config=cfg) as st:
+        next(iter(st))  # one chunk, then bail
+    assert st.join(10.0)
+    assert not [s for s in ksnap.list_snapshots(root) if s["valid"]]
+    # and the aborted temp directory was cleaned up, not leaked
+    assert not [
+        s for s in ksnap.list_snapshots(root) if s["dir"].startswith(".tmp-")
+    ]
+
+
+def test_snapshot_write_failure_degrades_to_live(tmp_path, tar10, monkeypatch):
+    """The cache is an optimization: a shard-write failure (full disk) is
+    a counted degradation, never a dead stream."""
+    path, names = tar10
+    root = str(tmp_path / "cache")
+
+    def boom(self, *a, **kw):
+        raise OSError("disk full (injected)")
+
+    monkeypatch.setattr(ksnap.SnapshotWriter, "add_chunk", boom)
+    before = counters.get("snapshot_write_failed")
+    got, st = _stream(path, 4, snapshot_dir=root)
+    assert sum(len(c[2]) for c in got) == len(names)  # stream completed
+    assert counters.get("snapshot_write_failed") == before + 1
+    assert st.stats.snapshot_chunks_written == 0
+    assert not [s for s in ksnap.list_snapshots(root) if s.get("valid")]
+
+
+def test_unusable_snapshot_root_degrades_to_live(tmp_path, tar10):
+    """An unusable snapshot ROOT (a path component is a regular file, an
+    unwritable parent) is the same counted degradation as a failed shard
+    write — the live-decode stream must survive the writer never opening."""
+    path, names = tar10
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where a directory must go")
+    root = str(blocker / "cache")
+    before = counters.get("snapshot_write_failed")
+    got, st = _stream(path, 4, snapshot_dir=root)
+    assert sum(len(c[2]) for c in got) == len(names)  # stream completed
+    assert counters.get("snapshot_write_failed") == before + 1
+    assert st.stats.snapshot_chunks_written == 0
+
+
+def test_featurized_mode_degrades_to_decoded_where_unsupported(
+    tmp_path, monkeypatch
+):
+    """Streams with no featurized wrapper (VOC/ImageNet descriptor passes)
+    must not let ``KEYSTONE_SNAPSHOT_MODE=featurized`` leave the cache dir
+    silently inert: counted downgrade to decoded caching instead."""
+    from keystone_tpu.workloads.fv_common import stream_config_from_flags
+
+    monkeypatch.setenv("KEYSTONE_SNAPSHOT_MODE", "featurized")
+    before = counters.get("snapshot_mode_unsupported")
+    cfg = stream_config_from_flags(snapshot_dir=str(tmp_path / "c"))
+    assert cfg.snapshot_mode == "decoded"
+    assert counters.get("snapshot_mode_unsupported") == before + 1
+    # a caller that wraps the stream in stream_features_snapshot keeps it
+    honored = stream_config_from_flags(
+        snapshot_dir=str(tmp_path / "c"), supports_featurized=True
+    )
+    assert honored.snapshot_mode == "featurized"
+    # no cache dir -> nothing is inert, nothing to count
+    monkeypatch.delenv("KEYSTONE_SNAPSHOT_DIR", raising=False)
+    off = stream_config_from_flags()
+    assert off.snapshot_dir is None
+    assert counters.get("snapshot_mode_unsupported") == before + 1
+
+
+def test_keep_filter_without_extra_disables_snapshot(tmp_path, tar10):
+    path, names = tar10
+    root = str(tmp_path / "cache")
+    cfg = ingest.StreamConfig.from_env(snapshot_dir=root)
+    with ingest.stream_batches(
+        path, 4, transfer=False, config=cfg, keep=lambda n: True
+    ) as st:
+        got = [b for b in st]
+    assert st.join(10.0)
+    assert sum(len(b) for b in got) == len(names)
+    assert st.stats.snapshot_chunks_written == 0
+    assert ksnap.list_snapshots(root) == []
+
+
+def test_writer_abort_leaves_no_trace(tmp_path):
+    root = str(tmp_path / "cache")
+    w = ksnap.SnapshotWriter(root, "ab" * 32, mode="decoded")
+    w.add_chunk(0, [0], ["x"], np.zeros((1, 4, 4, 3), np.float32))
+    w.abort()
+    assert ksnap.list_snapshots(root) == []
+
+
+def test_stale_is_mode_scoped(tmp_path, tar10):
+    """A committed FEATURIZED snapshot for the same tar must not make a
+    first decoded-mode lookup read as 'stale' — it was never a candidate
+    for the decoded key."""
+    path, _ = tar10
+    root = str(tmp_path / "cache")
+    w = ksnap.SnapshotWriter(
+        root,
+        ksnap.snapshot_key(
+            path, batch_size=4, mode="featurized", featurizer="d"
+        ),
+        mode="featurized",
+        meta={"tar": ksnap.tar_identity(path)},
+    )
+    w.add_chunk(0, [0], ["x"], np.zeros((1, 2), np.float32))
+    w.commit()
+    snap, reason = ksnap.lookup(
+        root, ksnap.snapshot_key(path, batch_size=4), tar_path=path
+    )
+    assert snap is None and reason == "miss"
+
+
+def test_evict_rejects_sweeping_prefixes(tmp_path, tar10):
+    path, _ = tar10
+    root = str(tmp_path / "cache")
+    _stream(path, 4, snapshot_dir=root)
+    with pytest.raises(ValueError, match="prefix"):
+        ksnap.evict(root, key_prefix="")
+    with pytest.raises(ValueError, match="prefix"):
+        ksnap.evict(root, key_prefix="ab")
+    assert len(ksnap.list_snapshots(root)) == 1  # nothing was removed
+
+
+# -- featurized snapshots (fv_common helper) ----------------------------------
+
+
+def test_featurized_snapshot_serves_and_invalidates(tmp_path, tar10):
+    from keystone_tpu.core.ingest import stream_batches
+    from keystone_tpu.workloads.fv_common import stream_features_snapshot
+
+    path, names = tar10
+    root = str(tmp_path / "cache")
+
+    def per_batch(batch):
+        return np.stack(
+            [batch.host.mean(axis=(1, 2, 3)), batch.host.max(axis=(1, 2, 3))],
+            axis=1,
+        ).astype(np.float32)
+
+    def key(digest):
+        return ksnap.snapshot_key(
+            path, batch_size=4, mode="featurized", featurizer=digest
+        )
+
+    def make_stream():
+        return stream_batches(
+            path, 4, transfer=False, config=ingest.StreamConfig.from_env()
+        )
+
+    live_feats, live_names, st = stream_features_snapshot(
+        make_stream, per_batch, root=root, key=key("model-v1"),
+        tar_path=path,
+    )
+    assert st is not None  # live pass streamed
+    assert live_names == names
+    snap_feats, snap_names, st2 = stream_features_snapshot(
+        make_stream, per_batch, root=root, key=key("model-v1"),
+        tar_path=path,
+    )
+    assert st2 is None  # served from the shards, nothing streamed
+    assert snap_names == live_names
+    assert np.array_equal(snap_feats, live_feats)
+    # a refit featurizer (new digest) must MISS — counted as staleness
+    # (a same-mode snapshot for this tar exists under the old key), and
+    # never replay stale features
+    stale_before = counters.get("snapshot_stale")
+    refit_feats, _, st3 = stream_features_snapshot(
+        make_stream, per_batch, root=root, key=key("model-v2"),
+        tar_path=path,
+    )
+    assert st3 is not None
+    assert counters.get("snapshot_stale") == stale_before + 1
+    assert np.array_equal(refit_feats, live_feats)
+    # corrupt featurized shard -> counted fallback to the live pass
+    v1_dir = next(
+        s["dir"]
+        for s in ksnap.list_snapshots(root)
+        if s.get("valid") and s["key"] == key("model-v1")
+    )
+    shard = sorted(glob.glob(os.path.join(root, v1_dir, "chunk_*.npz")))[0]
+    with open(shard, "r+b") as fh:
+        data = bytearray(fh.read())
+        data[len(data) // 2] ^= 0xFF
+        fh.seek(0)
+        fh.write(bytes(data))
+    before = counters.get("snapshot_fallback")
+    fb_feats, fb_names, st4 = stream_features_snapshot(
+        make_stream, per_batch, root=root, key=key("model-v1")
+    )
+    assert counters.get("snapshot_fallback") >= before + 1
+    assert st4 is not None and np.array_equal(fb_feats, live_feats)
+
+
+# -- the admin tool -----------------------------------------------------------
+
+
+def test_snapshot_admin_list_inspect_evict(tmp_path, tar10, capsys):
+    import snapshot_admin
+
+    path, _ = tar10
+    root = str(tmp_path / "cache")
+    _stream(path, 4, snapshot_dir=root)
+    key = ksnap.snapshot_key(path, batch_size=4)
+
+    assert snapshot_admin.main([root, "list"]) == 0
+    rec = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert rec["op"] == "list" and len(rec["snapshots"]) == 1
+    assert rec["snapshots"][0]["key"] == key
+
+    assert snapshot_admin.main([root, "inspect", key[:8]]) == 0
+    rec = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert rec["ok"]
+
+    # corrupt a shard: inspect must fail loudly
+    shard = sorted(glob.glob(os.path.join(root, "snap-*", "chunk_*.npz")))[0]
+    with open(shard, "ab") as fh:
+        fh.write(b"x")
+    assert snapshot_admin.main([root, "inspect", key[:8]]) == 1
+    rec = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert not rec["ok"] and rec["problems"]
+
+    assert snapshot_admin.main([root, "evict", "--key", key[:8]]) == 0
+    rec = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert len(rec["removed"]) == 1
+    assert ksnap.list_snapshots(root) == []
+
+
+def test_snapshot_admin_evicts_stale_and_temps(tmp_path, tar10, capsys):
+    import snapshot_admin
+
+    path, _ = tar10
+    root = str(tmp_path / "cache")
+    _stream(path, 4, snapshot_dir=root)
+    # a valid FEATURIZED snapshot for the same tar: --stale must not touch
+    # it (its key folds in a digest the admin tool cannot recompute)
+    wf = ksnap.SnapshotWriter(
+        root,
+        ksnap.snapshot_key(
+            path, batch_size=4, mode="featurized", featurizer="d"
+        ),
+        mode="featurized",
+        meta={"tar": ksnap.tar_identity(path)},
+    )
+    wf.add_chunk(0, [0], ["x"], np.zeros((1, 2), np.float32))
+    feat_dir = os.path.basename(wf.commit())
+    # make the committed decoded snapshot stale and add crash debris
+    st = os.stat(path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 10_000_000))
+    os.makedirs(os.path.join(root, ".tmp-deadbeef-123"))
+    # no --batch: staleness classification reads the manifest's RECORDED
+    # chunking, so no guessed probe list is involved
+    assert (
+        snapshot_admin.main(
+            [root, "evict", "--stale", "--tar", path, "--temps"]
+        )
+        == 0
+    )
+    rec = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert len(rec["removed"]) == 2
+    assert feat_dir not in rec["removed"]
+    left = ksnap.list_snapshots(root)
+    assert [s["dir"] for s in left] == [feat_dir]
+
+
+def test_snapshot_admin_stale_spares_current_exotic_batch(
+    tmp_path, tar10, capsys
+):
+    """A CURRENT snapshot whose batch size would never appear in a guessed
+    probe list must survive ``evict --stale`` (its exact key is recomputed
+    from the manifest's recorded chunking), while a genuinely stale
+    snapshot for the same tar is evicted in the same pass."""
+    import snapshot_admin
+
+    path, _ = tar10
+    root = str(tmp_path / "cache")
+    # a snapshot under a key that's already dead (the tar will be touched)
+    _stream(path, 4, snapshot_dir=root)
+    st = os.stat(path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 10_000_000))
+    # a CURRENT snapshot with an exotic batch size (post-touch identity)
+    _stream(path, 7, snapshot_dir=root)
+    current = os.path.basename(
+        ksnap._dir_for(root, ksnap.snapshot_key(path, batch_size=7))
+    )
+    assert snapshot_admin.main(
+        [root, "evict", "--stale", "--tar", path]
+    ) == 0
+    rec = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert len(rec["removed"]) == 1 and current not in rec["removed"]
+    left = ksnap.list_snapshots(root)
+    assert [s["dir"] for s in left] == [current]
+    # a manifest with no recorded chunking cannot prove staleness: --stale
+    # must refuse to guess (left alone without --batch)
+    mpath = os.path.join(root, current, ksnap.MANIFEST_NAME)
+    with open(mpath) as fh:
+        manifest = json.load(fh)
+    manifest["meta"].pop("batch_size")
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 20_000_000))
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh)
+    assert snapshot_admin.main(
+        [root, "evict", "--stale", "--tar", path]
+    ) == 0
+    rec = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert rec["removed"] == []
+    # ... until --batch supplies the missing chunking
+    assert snapshot_admin.main(
+        [root, "evict", "--stale", "--tar", path, "--batch", "7"]
+    ) == 0
+    rec = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert rec["removed"] == [current]
+
+
+def test_snapshot_admin_evict_invalid_is_surgical(tmp_path, tar10, capsys):
+    """--invalid removes exactly the manifest-less directories — including
+    ones whose names don't follow the snap- convention — and never a
+    valid snapshot."""
+    import snapshot_admin
+
+    path, _ = tar10
+    root = str(tmp_path / "cache")
+    _stream(path, 4, snapshot_dir=root)
+    os.makedirs(os.path.join(root, "tmp"))  # stray dir, no manifest
+    os.makedirs(os.path.join(root, "snap-0000000000000000"))  # no manifest
+    assert snapshot_admin.main([root, "evict", "--invalid"]) == 0
+    rec = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert sorted(rec["removed"]) == ["snap-0000000000000000", "tmp"]
+    left = ksnap.list_snapshots(root)
+    assert len(left) == 1 and left[0]["valid"]

@@ -159,3 +159,50 @@ def test_cifar_run_with_stream_test_tar_matches_eager(tmp_path, rng):
     np.testing.assert_array_equal(
         res["test_predictions"], base["test_predictions"]
     )
+
+
+def test_cifar_stream_featurized_snapshot_roundtrip(tmp_path, rng, monkeypatch):
+    """RandomPatchCifar --streamTestTar --snapshotDir under
+    KEYSTONE_SNAPSHOT_MODE=featurized: the first run materializes the conv
+    FEATURES keyed by the fitted featurizer's digest; a rerun serves them
+    from the shards and must score bit-identically.  A different model
+    (new filters) must MISS the cache, never replay stale features."""
+    from keystone_tpu.core import snapshot as ksnap
+    from keystone_tpu.workloads.cifar_random_patch import run
+
+    monkeypatch.setenv("KEYSTONE_SNAPSHOT_MODE", "featurized")
+    tar = str(tmp_path / "cifar48.tar")
+    labels = _write_cifar_tar(tar, 12, rng)
+    decoded = list(_iter_tar_images(tar, num_threads=1))
+    images = np.stack([img for _, img in decoded])
+    train = LabeledImageBatch(images, labels)
+    snap_root = str(tmp_path / "cache")
+    conf = RandomCifarConfig(
+        num_filters=4,
+        patch_steps=6,
+        lam=10.0,
+        whitener_size=64,
+        featurize_chunk=4,
+        num_classes=4,
+        stream_test_tar=tar,
+        snapshot_dir=snap_root,
+    )
+    cold = run(conf, train, train)
+    committed = [
+        s for s in ksnap.list_snapshots(snap_root)
+        if s.get("valid") and s["mode"] == "featurized"
+    ]
+    assert len(committed) == 1
+    warm = run(conf, train, train)
+    np.testing.assert_array_equal(
+        warm["test_predictions"], cold["test_predictions"]
+    )
+    # a refit with different filters keys a NEW snapshot (digest moved)
+    refit = run(dataclasses.replace(conf, num_filters=6), train, train)
+    assert refit["test_predictions"].shape[0] == len(labels)
+    keys = {
+        s["key"]
+        for s in ksnap.list_snapshots(snap_root)
+        if s.get("valid") and s["mode"] == "featurized"
+    }
+    assert len(keys) == 2

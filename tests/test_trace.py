@@ -353,14 +353,18 @@ def test_ingest_overlap_from_spans_matches_bench_methodology(
     """The bench ``e2e`` overlap efficiency = e2e_rate / min(decode_rate,
     featurize_rate), measured from three passes.  The trace recomputation
     (``max(decode_busy, consume_busy) / wall`` over span intervals of the
-    ONE e2e pass) must land within 5% of it.  Decode/featurize costs are
+    ONE e2e pass) must land within 12% of it.  Decode/featurize costs are
     pinned by sleeps so the comparison is about the span plumbing, not
     scheduler noise — decode-bound, the realistic streaming regime."""
-    # Sleep scale chosen so scheduler jitter (~10-20 ms per pass on a
-    # loaded CPU host) stays well inside the 5% band: the decode pass is
-    # ~0.7 s, so 5% is ~35 ms of headroom.
+    # Jitter budget: the decode pool's width floor is HOST CORES (the
+    # max_decode_threads default), so on a 2-core host TWO sleeps overlap
+    # and the decode pass runs ~24 x 0.05 / 2 = 0.6 s; cross-pass
+    # scheduler hiccups of ~80 ms were observed on loaded 2-core hosts,
+    # so the band is 12% (~70 ms) — a real span-accounting bug skews the
+    # two methodologies far past that (dropping the consume spans alone
+    # moves it > 30%).
     n_images, batch = 24, 4
-    decode_s, feat_s = 0.03, 0.015  # per image / per batch
+    decode_s, feat_s = 0.05, 0.015  # per image / per batch
     img = np.zeros((40, 40, 3), np.float32)
 
     def slow_decode(data):
@@ -405,7 +409,7 @@ def test_ingest_overlap_from_spans_matches_bench_methodology(
     assert overlap["consume_spans"] == -(-n_images // batch)
     trace_eff = overlap["overlap_efficiency"]
     assert trace_eff is not None
-    assert abs(trace_eff - bench_eff) <= 0.05 * bench_eff, (
+    assert abs(trace_eff - bench_eff) <= 0.12 * bench_eff, (
         f"trace-recomputed overlap {trace_eff} vs bench-methodology "
         f"{bench_eff:.3f} (decode {t_decode:.3f}s, feat {t_feat:.3f}s, "
         f"e2e {t_e2e:.3f}s)"

@@ -54,8 +54,9 @@ def main(argv=None) -> int:
         "--stream",
         action="store_true",
         help="run only the streaming-ingest fault schedules "
-        "(stream_corrupt / stream_hang / autotune_thrash families, "
-        "core.ingest path)",
+        "(stream_corrupt / stream_hang / autotune_thrash / "
+        "snapshot_corrupt / decode_worker_kill families, core.ingest + "
+        "core.snapshot paths)",
     )
     p.add_argument("--workload", default="mnist", choices=("mnist", "cifar"))
     p.add_argument(
@@ -78,7 +79,9 @@ def main(argv=None) -> int:
 
         def is_stream(seed: int) -> bool:
             kind = chaos.make_schedule(seed).kind
-            return kind.startswith("stream_") or kind == "autotune_thrash"
+            return kind.startswith("stream_") or kind in (
+                "autotune_thrash", "snapshot_corrupt", "decode_worker_kill",
+            )
 
         seeds = tuple(
             s
