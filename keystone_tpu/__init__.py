@@ -12,52 +12,64 @@ Layer map (SURVEY.md §1 -> here):
   L4' Evaluation                -> evaluation.*
   L5  Application pipelines     -> workloads.*
   L6  CLI launchers             -> python -m keystone_tpu.workloads.<name>
+
+Import discipline: ``import keystone_tpu`` must stay CHEAP — in particular
+it must not import jax.  Every spawned decode worker
+(core.ingest._decode_worker_main) re-imports this package in a fresh
+interpreter, and the old eager ``from .core.checkpoint import ...`` chain
+pulled jax (multi-second) into processes that only ever touch numpy/PIL.
+The public surface below is therefore resolved lazily via module-level
+``__getattr__`` (PEP 562): the first *attribute access* imports the
+defining submodule; a bare package import touches nothing.  A subprocess
+test (tests/test_lazy_import.py) holds the package to this contract.
 """
 
-from .core.checkpoint import (
-    CheckpointError,
-    checkpoint_exists,
-    load_or_fit,
-    load_pipeline,
-    save_pipeline,
-)
-from .core.pipeline import (
-    Cacher,
-    ChainedEstimator,
-    ChainedLabelEstimator,
-    Estimator,
-    FunctionNode,
-    FunctionTransformer,
-    Identity,
-    LabelEstimator,
-    Pipeline,
-    Transformer,
-    transformer,
-)
-from .core.resilience import assert_all_finite, retry
-from .parallel.mesh import make_mesh, use_mesh
+from __future__ import annotations
 
 __version__ = "0.1.0"
 
-__all__ = [
-    "Cacher",
-    "ChainedEstimator",
-    "ChainedLabelEstimator",
-    "CheckpointError",
-    "Estimator",
-    "FunctionNode",
-    "FunctionTransformer",
-    "Identity",
-    "LabelEstimator",
-    "Pipeline",
-    "Transformer",
-    "assert_all_finite",
-    "checkpoint_exists",
-    "load_or_fit",
-    "load_pipeline",
-    "make_mesh",
-    "retry",
-    "save_pipeline",
-    "transformer",
-    "use_mesh",
-]
+#: public name -> defining submodule, resolved on first attribute access.
+_EXPORTS = {
+    # core.checkpoint
+    "CheckpointError": "core.checkpoint",
+    "checkpoint_exists": "core.checkpoint",
+    "load_or_fit": "core.checkpoint",
+    "load_pipeline": "core.checkpoint",
+    "save_pipeline": "core.checkpoint",
+    # core.pipeline
+    "Cacher": "core.pipeline",
+    "ChainedEstimator": "core.pipeline",
+    "ChainedLabelEstimator": "core.pipeline",
+    "Estimator": "core.pipeline",
+    "FunctionNode": "core.pipeline",
+    "FunctionTransformer": "core.pipeline",
+    "Identity": "core.pipeline",
+    "LabelEstimator": "core.pipeline",
+    "Pipeline": "core.pipeline",
+    "Transformer": "core.pipeline",
+    "transformer": "core.pipeline",
+    # core.resilience
+    "assert_all_finite": "core.resilience",
+    "retry": "core.resilience",
+    # parallel.mesh
+    "make_mesh": "parallel.mesh",
+    "use_mesh": "parallel.mesh",
+}
+
+__all__ = sorted((*_EXPORTS, "__version__"))
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(f".{mod}", __name__), name)
+    # Cache on the package so the lookup (and the import) happens once.
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return __all__

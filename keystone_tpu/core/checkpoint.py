@@ -123,6 +123,17 @@ def _dtype_name(v) -> str | None:
         return v.name
     if isinstance(v, type) and issubclass(v, np.generic):
         return np.dtype(v).name
+    # jnp scalar aliases (jnp.bfloat16 / jnp.float32 ...) are _ScalarMeta
+    # instances, not types — the compute/activation dtype knobs nodes like
+    # FusedConvFeaturizer and SIFTExtractor carry.  np.dtype() resolves
+    # them; decode rebuilds the equivalent numpy scalar TYPE (ml_dtypes
+    # for extended floats), which every jnp dtype= site accepts — so a
+    # servable pipeline with bf16 activations checkpoints whole.
+    if type(v).__name__ == "_ScalarMeta":
+        try:
+            return np.dtype(v).name
+        except TypeError:
+            return None
     return None
 
 

@@ -88,13 +88,25 @@ from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Callable
 
-import jax
 import numpy as np
 
 from ..loaders import image_loaders
 from . import snapshot as ksnap
 from . import trace
 from .resilience import counters
+
+# NO module-level jax import: every spawned decode worker re-imports THIS
+# module (its target function _decode_worker_main lives here), and the only
+# jax consumer is the consumer-side H2D transfer — which a worker never
+# runs.  jax loads lazily at the first device_put instead of costing every
+# worker spawn multi-second interpreter startup (the bench_decode
+# total-vs-steady gap).  tests/test_lazy_import.py enforces this.
+
+
+def _device_put(host):
+    import jax
+
+    return jax.device_put(host)
 
 _logger = logging.getLogger("keystone_tpu.ingest")
 
@@ -658,7 +670,7 @@ class StreamBatch:
         """The device-resident batch (transferring on demand when the
         stream ran with ``transfer=False``)."""
         if self.device is None:
-            self.device = jax.device_put(self.host)
+            self.device = _device_put(self.host)
         return self.device
 
 
@@ -1276,7 +1288,7 @@ class IngestStream:
                     # Async dispatch: the H2D for this chunk starts now and
                     # overlaps the consumer's work on the PREVIOUS chunk
                     # still being featurized.
-                    item.device = jax.device_put(item.host)
+                    item.device = _device_put(item.host)
                 self._publish_metrics()
                 if self.tuner is not None:
                     # Chunk boundary: the closed-loop controller reads the

@@ -34,10 +34,15 @@ import threading
 import time
 from typing import Callable
 
-import jax
 import numpy as np
 
 from . import trace
+
+# NO module-level jax import, deliberately: this module sits on the import
+# path of every spawned decode worker (core.ingest pulls `counters` from
+# here), and jax costs multi-second interpreter startup those numpy-only
+# processes must not pay.  The one jax consumer (assert_all_finite) imports
+# it lazily; tests/test_lazy_import.py enforces the discipline.
 
 _logger = logging.getLogger("keystone_tpu.resilience")
 
@@ -234,6 +239,8 @@ def assert_all_finite(tree, name: str = "fitted model"):
     """Raise ``FloatingPointError`` if any inexact-dtype array leaf of
     ``tree`` contains NaN/Inf.  Returns ``tree`` so fit paths can guard
     inline: ``model = assert_all_finite(est.fit(x, y), "block solve")``."""
+    import jax
+
     bad = []
     for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
         if not isinstance(leaf, (np.ndarray, np.generic, jax.Array)):

@@ -66,6 +66,7 @@ MODES = ("decoded", "featurized")
 #: env vars (documented in README's KEYSTONE_* table)
 SNAPSHOT_DIR_ENV = "KEYSTONE_SNAPSHOT_DIR"
 SNAPSHOT_MODE_ENV = "KEYSTONE_SNAPSHOT_MODE"
+SNAPSHOT_COMPRESS_ENV = "KEYSTONE_SNAPSHOT_COMPRESS"
 
 
 class SnapshotError(RuntimeError):
@@ -92,6 +93,18 @@ def snapshot_mode_env() -> str:
             f"{SNAPSHOT_MODE_ENV}={raw!r} must be one of {MODES}"
         )
     return raw
+
+
+def snapshot_compress_env() -> bool:
+    """``KEYSTONE_SNAPSHOT_COMPRESS``: shard compression on the WRITE path
+    (``np.savez_compressed``; default ON — decoded uint8 pixels deflate
+    well and the warm path is shard-IO-bound, so smaller shards read
+    faster).  ``0`` writes plain ``np.savez``.  A READ-side knob does not
+    exist on purpose: ``np.load`` handles both formats transparently, so
+    shards written under either setting — including every pre-knob
+    snapshot — stay readable forever (the key does not fold compression
+    in: the decoded BITS are identical either way)."""
+    return os.environ.get(SNAPSHOT_COMPRESS_ENV, "").strip() != "0"
 
 
 # -- keys ---------------------------------------------------------------------
@@ -217,7 +230,13 @@ class SnapshotWriter:
     consumer exit must not commit a partial snapshot)."""
 
     def __init__(
-        self, root: str, key: str, *, mode: str, meta: dict | None = None
+        self,
+        root: str,
+        key: str,
+        *,
+        mode: str,
+        meta: dict | None = None,
+        compress: bool | None = None,
     ):
         if mode not in MODES:
             raise ValueError(f"snapshot mode {mode!r} must be one of {MODES}")
@@ -225,6 +244,9 @@ class SnapshotWriter:
         self._root = root
         self._key = key
         self._mode = mode
+        self._compress = (
+            snapshot_compress_env() if compress is None else bool(compress)
+        )
         self._meta = dict(meta or {})
         self._final = _dir_for(root, key)
         self._tmp = tempfile.mkdtemp(
@@ -254,7 +276,12 @@ class SnapshotWriter:
                 extra["payload_cast"] = np.asarray("float32")
                 payload = u8
         buf = io.BytesIO()
-        np.savez(
+        # Write-path-only choice: np.load reads both formats transparently,
+        # so compressed and plain shards coexist (old snapshots stay
+        # readable, and the shard sha256 below covers whichever bytes were
+        # written).
+        save = np.savez_compressed if self._compress else np.savez
+        save(
             buf,
             indices=np.asarray(indices, np.int64),
             names=np.asarray(list(names)),
@@ -277,6 +304,8 @@ class SnapshotWriter:
                 "sha256": hashlib.sha256(data).hexdigest(),
                 "images": int(payload.shape[0]),
                 "shape": list(payload.shape),
+                "compressed": self._compress,
+                "payload_bytes": int(payload.nbytes),
             }
         )
         self._images += int(payload.shape[0])
@@ -292,6 +321,7 @@ class SnapshotWriter:
             "key": self._key,
             "mode": self._mode,
             "images": self._images,
+            "compress": self._compress,
             "chunks": self._chunks,
             "meta": self._meta,
         }

@@ -108,6 +108,45 @@ class ZipVectors(FunctionNode):
         return jnp.concatenate(list(batches), axis=-1)
 
 
+@node(data_fields=("groups",), meta_fields=())
+class GroupConcatFeaturizer(Transformer):
+    """The MnistRandomFFT featurize phase as ONE chainable (and
+    checkpointable) node: each GROUP of per-FFT chains runs on the same
+    input batch, ZipVectors concatenates within the group, and the groups
+    concatenate along the feature axis — ``[n, d] -> [n, G * group_width]``.
+
+    This exists for the serving path (ISSUE 8): the fit loop keeps feeding
+    :class:`~..solvers.block.BlockLinearMapper` the per-group batches
+    directly (streaming evaluation wants blocks), but a *fitted* pipeline
+    shipped to an endpoint must be one Transformer chain —
+    ``GroupConcatFeaturizer >> model >> MaxClassifier`` — whose concatenated
+    output the model's ``VectorSplitter`` cuts back into exactly the
+    per-group blocks (each group is ``block_size`` wide by construction),
+    so served scores are bit-equal to the fit-path apply.  ``groups`` is a
+    data field: the chains are registered-node Pipelines, so the whole
+    thing checkpoints through ``core.checkpoint`` and flows through jit as
+    a pytree (fitted arrays stay program arguments, not baked constants).
+    """
+
+    def __init__(self, groups: Sequence[Sequence[Transformer]]):
+        self.groups = tuple(tuple(g) for g in groups)
+
+    def __call__(self, batch):
+        return jnp.concatenate(
+            [
+                ZipVectors.apply([chain(batch) for chain in group])
+                for group in self.groups
+            ],
+            axis=-1,
+        )
+
+    def __repr__(self):
+        return (
+            f"GroupConcatFeaturizer({len(self.groups)} groups x "
+            f"{len(self.groups[0]) if self.groups else 0} chains)"
+        )
+
+
 class VectorSplitter(FunctionNode):
     """Split [N, d] features into ⌈d/block_size⌉ feature blocks — the
     model-parallel decomposition primitive

@@ -79,10 +79,32 @@ class BlockLinearMapper(Transformer):
             out = out + self.b
         return out
 
+    def _split_features(self, batch):
+        """Cut a concatenated [n, D] feature matrix into this model's OWN
+        fitted block widths.  The nominal ``vector_splitter`` (block_size
+        cuts) only agrees with the fitted blocks when every block except
+        the last is exactly block_size wide; a model fit on pre-split
+        batches narrower than block_size (MnistRandomFFT's per-FFT-group
+        batches) needs the true widths — the serving path applies the model
+        to ``GroupConcatFeaturizer``'s concatenation and must recover the
+        fit-path blocks bit-exactly."""
+        widths = [int(x.shape[0]) for x in self.xs]
+        if int(batch.shape[-1]) != sum(widths):
+            raise ValueError(
+                f"feature matrix is {int(batch.shape[-1])} wide but the "
+                f"model's blocks sum to {sum(widths)} ({widths})"
+            )
+        out = []
+        i = 0
+        for w in widths:
+            out.append(batch[..., i : i + w])
+            i += w
+        return out
+
     def __call__(self, batch):
         if isinstance(batch, (list, tuple)):
             return self.apply_blocks(batch)
-        return self.apply_blocks(self.vector_splitter(batch))
+        return self.apply_blocks(self._split_features(batch))
 
     def apply_and_evaluate(
         self, batch_or_blocks, evaluator: Callable[[jnp.ndarray], None]
@@ -93,7 +115,7 @@ class BlockLinearMapper(Transformer):
         blocks = (
             batch_or_blocks
             if isinstance(batch_or_blocks, (list, tuple))
-            else self.vector_splitter(batch_or_blocks)
+            else self._split_features(batch_or_blocks)
         )
         if len(blocks) != len(self.xs):
             raise ValueError(

@@ -36,6 +36,7 @@ import numpy as np
 
 from ..core import optimize, trace
 from ..core import snapshot as ksnap
+from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
 from ..core.ingest import stream_batches
 from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
@@ -57,6 +58,7 @@ from ..parallel.mesh import parse_mesh, row_sharding
 from ..solvers.block import BlockLeastSquaresEstimator
 from ..solvers.whitening import ZCAWhitenerEstimator
 from ..utils.stats import normalize_rows
+from . import serve_common
 from .fv_common import stream_config_from_flags, stream_features_snapshot
 
 
@@ -109,6 +111,16 @@ class RandomCifarConfig:
     #: the fitted featurizer's digest — and repeat runs stream the shards
     #: at IO speed.  None defers to ``KEYSTONE_SNAPSHOT_DIR``.
     snapshot_dir: str | None = None
+    #: Whole-fitted-SERVABLE-pipeline checkpoint stem (core.checkpoint):
+    #: load-or-fit of conv featurizer + scaler + model + classifier — the
+    #: artifact the serving endpoint warm-loads.
+    pipeline_file: str | None = None
+    #: Serving modes (core.serve via serve_common); both need
+    #: ``pipeline_file`` and an eager test split (requests are test images).
+    serve: bool = False
+    serve_bench: bool = False
+    serve_clients: int = 4
+    serve_requests: int = 256
 
 
 class _Log(Logging):
@@ -292,6 +304,12 @@ def run(
     log = _Log()
     t0 = time.perf_counter()
 
+    if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
+        # Deploy-once/apply-many: filter learning, featurize, and the solve
+        # are all skipped — the servable chain restores whole and the run
+        # scores/serves the eager test split with it.
+        return _run_restored(conf, test, log, t0)
+
     if conf.sample_frac is not None:
         rng = np.random.default_rng(conf.seed)
         keep = rng.random(len(train)) < conf.sample_frac
@@ -474,10 +492,88 @@ def run(
         results["cache_plan"] = cache_plan.record()
     if conf.stream_test_tar is not None and results_autotune is not None:
         results["autotune"] = results_autotune
+    # The fitted SERVABLE chain, checkpointed whole for the endpoint:
+    # conv featurizer + fitted scaler + model + classifier as ONE pipeline
+    # (model splits the features by its own fitted block widths).
+    servable = Pipeline([*conv_pipe.nodes, scaler, model, MaxClassifier()])
+    if conf.pipeline_file is not None:
+        save_pipeline(conf.pipeline_file, servable)
+        log.log_info("saved fitted servable pipeline to %s", conf.pipeline_file)
+    _maybe_serve(conf, test, results, log)
     log.log_info("Training error is: %s", train_eval.total_error)
     log.log_info("Test error is: %s", test_eval.total_error)
     log.log_info("Pipeline took %.3f s", secs)
     return results
+
+
+def _apply_servable_chunked(servable, images: np.ndarray, chunk: int):
+    """Apply the servable chain in fixed-size chunks (pad the tail) so the
+    conv activations never exceed one chunk's HBM footprint — the restored
+    path's analog of :func:`featurize_chunked`."""
+    outs = []
+    for i in range(0, images.shape[0], chunk):
+        block = images[i : i + chunk]
+        pad = chunk - block.shape[0]
+        if pad:
+            block = np.pad(block, ((0, pad), (0, 0), (0, 0), (0, 0)))
+        pred = np.asarray(servable(jnp.asarray(block)))
+        outs.append(pred[: chunk - pad] if pad else pred)
+    return np.concatenate(outs, axis=0)
+
+
+def _run_restored(conf: RandomCifarConfig, test, log, t0: float) -> dict:
+    """Score (and serve) with the restored servable pipeline — no refit."""
+    log.log_info(
+        "restoring fitted servable pipeline from %s", conf.pipeline_file
+    )
+    servable = load_pipeline(conf.pipeline_file)
+    if len(test.labels) == 0:
+        raise ValueError(
+            "restored servable runs score the EAGER test split — provide "
+            "--testLocation (streamed test tars have no resident images "
+            "to serve)"
+        )
+    test_pred = _apply_servable_chunked(
+        servable, np.asarray(test.images, np.float32), conf.featurize_chunk
+    )
+    test_eval = MulticlassClassifierEvaluator(
+        test_pred, test.labels, conf.num_classes
+    )
+    results: dict = {
+        "restored": True,
+        "test_error": 100.0 * test_eval.total_error,
+        "test_predictions": np.asarray(test_pred),
+    }
+    log.log_info(
+        "Test error is: %s (restored pipeline)", test_eval.total_error
+    )
+    _maybe_serve(conf, test, results, log)
+    results["seconds"] = time.perf_counter() - t0
+    return results
+
+
+def _maybe_serve(conf: RandomCifarConfig, test, results: dict, log) -> None:
+    if not (conf.serve or conf.serve_bench):
+        return
+    if conf.pipeline_file is None:
+        raise ValueError(
+            "--serve/--serveBench need --pipelineFile — the endpoint "
+            "warm-loads the fitted artifact, it never refits"
+        )
+    if len(test.labels) == 0:
+        raise ValueError(
+            "serving draws its requests from the EAGER test split — "
+            "provide --testLocation"
+        )
+    requests = np.asarray(test.images[: conf.serve_requests], np.float32)
+    results["serving"] = serve_common.serve_fitted(
+        conf.pipeline_file,
+        jax.ShapeDtypeStruct(tuple(requests.shape[1:]), np.float32),
+        requests,
+        label="random_patch_cifar",
+        bench=conf.serve_bench,
+        clients=conf.serve_clients,
+    )
 
 
 def main(argv=None):
@@ -543,6 +639,14 @@ def main(argv=None):
         "stall metrics (KEYSTONE_AUTOTUNE=1 equivalent)",
     )
     p.add_argument(
+        "--pipelineFile",
+        default=None,
+        help="fitted-SERVABLE-pipeline checkpoint stem: load-or-fit of "
+        "conv featurizer + scaler + model + classifier in one artifact "
+        "(what --serve/--serveBench warm-load)",
+    )
+    serve_common.add_serve_args(p)
+    p.add_argument(
         "--trace",
         default=None,
         metavar="PATH",
@@ -552,6 +656,13 @@ def main(argv=None):
     a = p.parse_args(argv)
     if a.trace:
         trace.enable(a.trace)
+    if (a.serve or a.serveBench) and not a.pipelineFile:
+        p.error("--serve/--serveBench require --pipelineFile")
+    if (a.serve or a.serveBench) and a.streamTestTar is not None:
+        p.error(
+            "--serve/--serveBench draw requests from the eager test split "
+            "— use --testLocation, not --streamTestTar, for serving runs"
+        )
     # Before the load stage timer, so its log line has a handler to land on
     # (run() re-applies the same idempotent configuration).
     configure_logging()
@@ -572,6 +683,11 @@ def main(argv=None):
         auto_tune=a.autoTune,
         decode_backend=a.decodeBackend,
         snapshot_dir=a.snapshotDir,
+        pipeline_file=a.pipelineFile,
+        serve=a.serve,
+        serve_bench=a.serveBench,
+        serve_clients=a.serveClients,
+        serve_requests=a.serveRequests,
     )
     if a.testLocation is None and a.streamTestTar is None:
         p.error("one of --testLocation / --streamTestTar is required")

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import optimize, trace
+from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
 from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
 from ..core.pipeline import Pipeline
@@ -27,9 +28,15 @@ from ..core.resilience import assert_all_finite, numerics_guard_enabled
 from ..evaluation.multiclass import MulticlassClassifierEvaluator
 from ..loaders.csv_loader import LabeledData, csv_data_loader
 from ..ops.stats import LinearRectifier, PaddedFFT, RandomSignNode
-from ..ops.util import ClassLabelIndicatorsFromIntLabels, MaxClassifier, ZipVectors
+from ..ops.util import (
+    ClassLabelIndicatorsFromIntLabels,
+    GroupConcatFeaturizer,
+    MaxClassifier,
+    ZipVectors,
+)
 from ..parallel.mesh import padded_shard_rows, parse_mesh
 from ..solvers.block import BlockLeastSquaresEstimator
+from . import serve_common
 
 
 @dataclass
@@ -57,6 +64,18 @@ class MnistRandomFFTConfig:
     #: ``KEYSTONE_HBM_BUDGET`` the optimizer picks recompute instead of
     #: OOMing on residency.  Decision table in ``results["cache_plan"]``.
     auto_cache: bool = False
+    #: Whole-fitted-SERVABLE-pipeline checkpoint stem (core.checkpoint):
+    #: load-or-fit of ``GroupConcatFeaturizer >> model >> MaxClassifier``
+    #: — the artifact the serving endpoint warm-loads.
+    pipeline_file: str | None = None
+    #: Serving modes (core.serve via serve_common): ``serve`` answers the
+    #: test split through the warm endpoint and asserts bit-equality;
+    #: ``serve_bench`` runs the concurrent-client SLO bench.  Both require
+    #: ``pipeline_file``.
+    serve: bool = False
+    serve_bench: bool = False
+    serve_clients: int = 4
+    serve_requests: int = 256
 
 
 def build_featurizer_batches(conf: MnistRandomFFTConfig):
@@ -95,6 +114,12 @@ def run(
     configure_logging()
     log = _Log()
     t0 = time.perf_counter()
+
+    if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
+        # Deploy-once/apply-many: the fitted servable chain restores whole
+        # (featurize groups + model + classifier), training data is never
+        # touched, and the run scores/serves with the restored pipeline.
+        return _run_restored(conf, test, log, t0)
 
     labels = ClassLabelIndicatorsFromIntLabels(conf.num_classes)(train.labels)
     batch_featurizer = build_featurizer_batches(conf)
@@ -206,9 +231,61 @@ def run(
         model.apply_and_evaluate(training_batches, train_eval)
         model.apply_and_evaluate(test_batches, test_eval)
 
+    # The fitted SERVABLE chain: the same featurize groups as one node,
+    # whose concatenated output the model's VectorSplitter cuts back into
+    # exactly the per-group blocks — served scores bit-equal the fit-path
+    # apply.  Checkpointed whole for the serving endpoint to warm-load.
+    servable = Pipeline(
+        [GroupConcatFeaturizer(batch_featurizer), model, MaxClassifier()]
+    )
+    if conf.pipeline_file is not None:
+        save_pipeline(conf.pipeline_file, servable)
+        log.log_info("saved fitted servable pipeline to %s", conf.pipeline_file)
+    _maybe_serve(conf, test, results, log)
+
     results["seconds"] = time.perf_counter() - t0
     log.log_info("Pipeline took %.3f s", results["seconds"])
     return results
+
+
+def _run_restored(conf: MnistRandomFFTConfig, test, log, t0: float) -> dict:
+    """Score (and serve) with the restored servable pipeline — no refit."""
+    log.log_info(
+        "restoring fitted servable pipeline from %s", conf.pipeline_file
+    )
+    servable = load_pipeline(conf.pipeline_file)
+    predicted = servable(jnp.asarray(test.data))
+    ev = MulticlassClassifierEvaluator(
+        predicted, test.labels, conf.num_classes
+    )
+    results: dict = {
+        "restored": True,
+        "test_error": 100.0 * ev.total_error,
+        "test_predictions": np.asarray(predicted),
+    }
+    log.log_info("TEST Error is %s%% (restored pipeline)", results["test_error"])
+    _maybe_serve(conf, test, results, log)
+    results["seconds"] = time.perf_counter() - t0
+    return results
+
+
+def _maybe_serve(conf: MnistRandomFFTConfig, test, results: dict, log) -> None:
+    if not (conf.serve or conf.serve_bench):
+        return
+    if conf.pipeline_file is None:
+        raise ValueError(
+            "--serve/--serveBench need --pipelineFile — the endpoint "
+            "warm-loads the fitted artifact, it never refits"
+        )
+    requests = np.asarray(test.data[: conf.serve_requests], np.float32)
+    results["serving"] = serve_common.serve_fitted(
+        conf.pipeline_file,
+        jax.ShapeDtypeStruct((requests.shape[1],), np.float32),
+        requests,
+        label="mnist_random_fft",
+        bench=conf.serve_bench,
+        clients=conf.serve_clients,
+    )
 
 
 class _Log(Logging):
@@ -247,6 +324,14 @@ def main(argv=None):
         "(KEYSTONE_AUTOCACHE=1 equivalent)",
     )
     p.add_argument(
+        "--pipelineFile",
+        default=None,
+        help="fitted-SERVABLE-pipeline checkpoint stem: load-or-fit of "
+        "featurize groups + model + classifier in one artifact (what "
+        "--serve/--serveBench warm-load)",
+    )
+    serve_common.add_serve_args(p)
+    p.add_argument(
         "--trace",
         default=None,
         metavar="PATH",
@@ -271,7 +356,14 @@ def main(argv=None):
         solve_checkpoint=a.solveCheckpoint,
         solve_resume=a.resumeFrom,
         auto_cache=a.autoCache or optimize.auto_cache_env(),
+        pipeline_file=a.pipelineFile,
+        serve=a.serve,
+        serve_bench=a.serveBench,
+        serve_clients=a.serveClients,
+        serve_requests=a.serveRequests,
     )
+    if (a.serve or a.serveBench) and not a.pipelineFile:
+        p.error("--serve/--serveBench require --pipelineFile")
     # Labels in the files are 1-indexed (reference :40-42)
     with stage_timer("load"):
         train = LabeledData.from_rows(

@@ -16,6 +16,7 @@ import os
 import time
 from dataclasses import dataclass
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -24,6 +25,7 @@ from ..core.checkpoint import checkpoint_exists, load_pipeline, save_pipeline
 from ..core.ingest import stream_batches
 from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
+from ..core.pipeline import FunctionTransformer, Pipeline
 from ..core.resilience import assert_all_finite
 from ..evaluation.map import MeanAveragePrecisionEvaluator
 from ..loaders.image_loaders import (
@@ -38,6 +40,7 @@ from ..parallel.mesh import parse_mesh
 from ..solvers.block import BlockLeastSquaresEstimator
 from ..solvers.gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
 from ..solvers.pca import BatchPCATransformer, compute_pca
+from . import serve_common
 from .fv_common import (
     bucket_by_shape,
     collect_autotune,
@@ -140,6 +143,15 @@ class SIFTFisherConfig:
     # sampling and Fisher featurization, or are re-projected per consumer
     # under a tight HBM budget.  Decision table in results["cache_plan"].
     auto_cache: bool = False
+    # Serving modes (core.serve via serve_common): warm-load the
+    # pipeline_file bundle, assemble the servable chain (grayscale ->
+    # SIFT -> PCA -> Fisher features -> model), and answer/SLO-bench
+    # requests drawn from the eager test split's modal image shape (one
+    # engine per shape — the static-shape discipline).
+    serve: bool = False
+    serve_bench: bool = False
+    serve_clients: int = 4
+    serve_requests: int = 64
 
 
 class _Log(Logging):
@@ -354,9 +366,68 @@ def run(
     if autotune:
         results["autotune"] = autotune
         log.log_info("ingest autotune: %s", autotune)
+    _maybe_serve(conf, test, results, log)
     log.log_info("TEST APs are: %s", ",".join(str(a) for a in aps))
     log.log_info("TEST MAP is: %s", results["map"])
     return results
+
+
+def servable_pipeline(conf: SIFTFisherConfig, bundle: dict) -> Pipeline:
+    """Assemble the fitted apply-chain from a ``--pipelineFile`` bundle
+    ({pca, gmm, model}) into ONE servable Transformer: grayscale -> dense
+    SIFT -> BatchPCA -> Fisher features -> per-class scores.  The SIFT
+    node is reconstructed from config (it holds no fitted state); the
+    fitted arrays ride in the bundle's registered nodes, so the chain
+    flows through jit as a pytree."""
+    sift = SIFTExtractor(
+        step_size=conf.sift_step_size,
+        scale_step=conf.scale_step,
+        compute_dtype=jnp.bfloat16,
+    )
+    fisher = fisher_feature_pipeline(bundle["gmm"])
+    return Pipeline(
+        [
+            FunctionTransformer(grayscale, name="grayscale"),
+            sift,
+            bundle["pca"],
+            FunctionTransformer(fisher, name="fisher_features"),
+            bundle["model"],
+        ]
+    )
+
+
+def _maybe_serve(conf: SIFTFisherConfig, test, results: dict, log) -> None:
+    if not (conf.serve or conf.serve_bench):
+        return
+    if conf.pipeline_file is None:
+        raise ValueError(
+            "--serve/--serveBench need --pipelineFile — the endpoint "
+            "warm-loads the fitted {pca, gmm, model} bundle, it never refits"
+        )
+    images = getattr(test, "images", None)
+    if isinstance(images, VOCStreamSource) or not hasattr(images, "__len__"):
+        raise ValueError(
+            "serving draws requests from the EAGER test split — run "
+            "--serve/--serveBench without --streamIngest"
+        )
+    # One engine serves ONE request shape (the static-shape discipline the
+    # shape-bucketed featurize already follows): requests come from the
+    # test split's most populous shape bucket.
+    buckets = bucket_by_shape(images)
+    shape, (idx, batch) = max(buckets.items(), key=lambda kv: len(kv[1][0]))
+    requests = np.asarray(batch, np.float32)[: conf.serve_requests]
+    record = serve_common.serve_fitted(
+        conf.pipeline_file,
+        jax.ShapeDtypeStruct(tuple(requests.shape[1:]), np.float32),
+        requests,
+        label="voc_sift_fisher",
+        wrap=lambda bundle: servable_pipeline(conf, bundle),
+        bench=conf.serve_bench,
+        clients=conf.serve_clients,
+    )
+    record["request_shape"] = list(requests.shape[1:])
+    record["shape_buckets_total"] = len(buckets)
+    results["serving"] = record
 
 
 def main(argv=None):
@@ -426,6 +497,7 @@ def main(argv=None):
         "runs stream the shards at IO speed "
         "(KEYSTONE_SNAPSHOT_DIR equivalent)",
     )
+    serve_common.add_serve_args(p)
     p.add_argument(
         "--mesh",
         default=None,
@@ -441,6 +513,13 @@ def main(argv=None):
     a = p.parse_args(argv)
     if a.trace:
         trace.enable(a.trace)
+    if (a.serve or a.serveBench) and not a.pipelineFile:
+        p.error("--serve/--serveBench require --pipelineFile")
+    if (a.serve or a.serveBench) and a.streamIngest:
+        p.error(
+            "--serve/--serveBench draw requests from the eager test split "
+            "— drop --streamIngest for serving runs"
+        )
     conf = SIFTFisherConfig(
         train_location=a.trainLocation,
         test_location=a.testLocation,
@@ -458,6 +537,10 @@ def main(argv=None):
         pipeline_file=a.pipelineFile,
         solve_checkpoint=a.solveCheckpoint,
         auto_cache=a.autoCache or optimize.auto_cache_env(),
+        serve=a.serve,
+        serve_bench=a.serveBench,
+        serve_clients=a.serveClients,
+        serve_requests=a.serveRequests,
     )
     if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
         # Restored runs never touch training data — skip decoding the

@@ -50,6 +50,20 @@ draws its parameters — fully deterministic):
   mid-stream: the pool must respawn it (counted
   ``decode_worker_respawn``) and finish with features bit-equal to the
   thread-path oracle — never a hung ring, never a lost image.
+* ``slow_client`` — one client trickles requests with long think times
+  while another hammers the SAME endpoint (core.serve): the batcher's
+  deadline/idle flush must keep answering the fast client (never wait for
+  a full bucket that the slow client will not fill), every answer
+  bit-equal to the offline apply.
+* ``malformed_request`` — wrong-shape / NaN / uncastable payloads
+  interleaved with good requests: each dies at ``submit`` with a typed,
+  counted :class:`~keystone_tpu.core.serve.MalformedRequest` and NEVER
+  enters a batch — the good batchmates' answers stay bit-equal.
+* ``serve_burst_oom`` — injected RESOURCE_EXHAUSTED on the largest batch
+  bucket under a request burst: the engine retires the bucket (counted
+  ``serve_burst_oom``), re-answers the same requests through smaller
+  buckets, and every answer stays bit-equal — degradation, never a
+  silent wrong answer and never a dead endpoint.
 """
 
 from __future__ import annotations
@@ -103,12 +117,19 @@ FAMILIES = (
     "autotune_thrash",
     "snapshot_corrupt",
     "decode_worker_kill",
+    "slow_client",
+    "malformed_request",
+    "serve_burst_oom",
 )
+
+#: The serving-path families (core.serve), selectable via
+#: ``tools/chaos_run.py --serve``.
+SERVE_FAMILIES = ("slow_client", "malformed_request", "serve_burst_oom")
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(12))
-FULL_SEEDS = tuple(range(24))
+TIER1_SEEDS = tuple(range(15))
+FULL_SEEDS = tuple(range(30))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -224,6 +245,25 @@ def make_schedule(seed: int) -> Fault:
         )
     if kind == "decode_worker_kill":
         return Fault(kind, {"batch": 4, "procs": 2})
+    if kind == "slow_client":
+        return Fault(
+            kind,
+            {
+                "slow_requests": int(rng.integers(2, 5)),
+                "think_seconds": 0.05,
+                "fast_requests": int(rng.integers(12, 25)),
+            },
+        )
+    if kind == "malformed_request":
+        return Fault(
+            kind,
+            {"bad": int(rng.integers(2, 5)), "good": int(rng.integers(8, 17))},
+        )
+    if kind == "serve_burst_oom":
+        return Fault(
+            kind,
+            {"burst": int(rng.integers(9, 17)), "failures": 1},
+        )
     return Fault("deadline", {"seconds": 1.0})
 
 
@@ -743,6 +783,217 @@ def _decode_worker_kill_phase(fault: Fault, tmpdir: str, seed: int) -> None:
     )
 
 
+# -- the serving-path phases (core.serve) -------------------------------------
+
+
+def _serve_engine(buckets=(1, 2, 4)):
+    """A tiny deterministic warm endpoint: fixed-weight row-wise pipeline,
+    parity-verified per-bucket AOT executables.  Weights are seeded from
+    the schedule-invariant data seed so the offline oracle is stable."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.core import serve as kserve
+    from keystone_tpu.core.pipeline import FunctionTransformer
+
+    rng = np.random.default_rng(_DATA_SEED)
+    # Fusion-invariant arithmetic (one exactly-rounded multiply + max, no
+    # fma/gemv rounding variance): eager == jit == every bucket on every
+    # backend, so the phases' offline-oracle equality checks test the
+    # BATCHER's behavior, not XLA's rounding moods.
+    w = jnp.asarray(rng.normal(size=(16,)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(16,)).astype(np.float32))
+
+    pipe = FunctionTransformer(
+        lambda x: jnp.maximum(x * w, b), name="chaos_serve"
+    )
+    cfg = kserve.ServeConfig(buckets=tuple(buckets), max_wait_ms=2.0)
+    return kserve.ServingEngine(
+        pipe, np.zeros(16, np.float32), config=cfg, label="chaos"
+    )
+
+
+def _serve_requests(rng, n: int) -> np.ndarray:
+    return rng.normal(size=(n, 16)).astype(np.float32)
+
+
+def _slow_client_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """One trickling client + one hammering client on the same endpoint:
+    the deadline/idle flush must answer the fast client without waiting
+    for buckets the slow client never fills — every answer bit-equal."""
+    import threading
+
+    from keystone_tpu.core import serve as kserve
+
+    rng = np.random.default_rng(seed)
+    engine = _serve_engine()
+    n_slow = int(fault.params["slow_requests"])
+    n_fast = int(fault.params["fast_requests"])
+    think = float(fault.params["think_seconds"])
+    slow_reqs = _serve_requests(rng, n_slow)
+    fast_reqs = _serve_requests(rng, n_fast)
+    slow_ans = [None] * n_slow
+    fast_ans = [None] * n_fast
+    errors: list = []
+
+    with kserve.Server(engine) as server:
+
+        def slow():
+            try:
+                for i, r in enumerate(slow_reqs):
+                    slow_ans[i] = server.submit(r).result(30.0)
+                    time.sleep(think)  # the think time: a slow client
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        def fast():
+            try:
+                futs = [server.submit(r) for r in fast_reqs]
+                for i, f in enumerate(futs):
+                    fast_ans[i] = f.result(30.0)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        ts = [threading.Thread(target=slow), threading.Thread(target=fast)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60.0)
+        stats = server.stats
+    if errors:
+        raise errors[0]
+    if not np.array_equal(np.stack(slow_ans), engine.offline(slow_reqs)):
+        raise ChaosOracleError(
+            "slow client's answers differ from the offline apply"
+        )
+    if not np.array_equal(np.stack(fast_ans), engine.offline(fast_reqs)):
+        raise ChaosOracleError(
+            "fast client's answers differ from the offline apply — a slow "
+            "batchmate changed RESULTS, not just latency"
+        )
+    if stats.answered != n_slow + n_fast:
+        raise ChaosOracleError(
+            f"{stats.answered} answered != {n_slow + n_fast} submitted"
+        )
+    # The trickle must have been answered by deadline/idle flushes (a
+    # strict full-bucket batcher would stall the slow client forever).
+    if stats.flush_deadline + stats.flush_idle < 1:
+        raise ChaosOracleError(
+            "no deadline/idle flush fired — the slow client was only "
+            "answered because the fast client happened to fill buckets"
+        )
+    counters.record(
+        "chaos_slow_client",
+        f"seed {seed}: {n_slow} trickled + {n_fast} hammered requests "
+        "answered bit-equal",
+    )
+
+
+def _malformed_request_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """Malformed payloads interleaved with good requests: each dies TYPED
+    at submit (counted serve_malformed_request), no batchmate poisoned."""
+    from keystone_tpu.core import serve as kserve
+
+    rng = np.random.default_rng(seed)
+    engine = _serve_engine()
+    n_bad = int(fault.params["bad"])
+    n_good = int(fault.params["good"])
+    good = _serve_requests(rng, n_good)
+    bad_payloads = []
+    for i in range(n_bad):
+        kind = i % 3
+        if kind == 0:  # wrong shape
+            bad_payloads.append(np.zeros(7, np.float32))
+        elif kind == 1:  # NaN-poisoned
+            r = _serve_requests(rng, 1)[0]
+            r[int(rng.integers(0, 16))] = np.nan
+            bad_payloads.append(r)
+        else:  # uncastable dtype
+            bad_payloads.append(np.array(["x"] * 16, dtype=object))
+
+    before = counters.get("serve_malformed_request")
+    rejected = 0
+    with kserve.Server(engine) as server:
+        futs = []
+        for j in range(n_good + n_bad):
+            if j % 2 == 0 and j // 2 < n_bad:
+                try:
+                    server.submit(bad_payloads[j // 2])
+                except kserve.MalformedRequest:
+                    rejected += 1
+                else:
+                    raise ChaosOracleError(
+                        "malformed request was ACCEPTED into the queue"
+                    )
+            if j < n_good:
+                futs.append(server.submit(good[j]))
+        answers = np.stack([f.result(30.0) for f in futs])
+    if rejected != n_bad:
+        raise ChaosOracleError(
+            f"{n_bad} malformed payloads but {rejected} typed rejections"
+        )
+    if counters.get("serve_malformed_request") - before != n_bad:
+        raise ChaosOracleError(
+            "malformed rejections were not all counted "
+            "(serve_malformed_request delta != injected)"
+        )
+    if not np.array_equal(answers, engine.offline(good)):
+        raise ChaosOracleError(
+            "good requests' answers differ from the offline apply — a "
+            "malformed batchmate poisoned the batch"
+        )
+
+
+def _serve_burst_oom_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """RESOURCE_EXHAUSTED on the largest bucket under a burst: the engine
+    must retire the bucket (counted serve_burst_oom), re-answer the same
+    requests through smaller buckets, and stay bit-equal — the endpoint
+    degrades, it never dies and never serves a wrong answer."""
+    from keystone_tpu.core import serve as kserve
+
+    rng = np.random.default_rng(seed)
+    engine = _serve_engine(buckets=(1, 2, 4))
+    burst = int(fault.params["burst"])
+    failures = int(fault.params["failures"])
+    top = engine.buckets()[-1]
+    real_execute = engine._execute
+    state = {"n": 0}
+
+    def failing_execute(bucket, dev_batch):
+        if bucket == top and state["n"] < failures:
+            state["n"] += 1
+            raise faults.resource_exhausted_error()
+        return real_execute(bucket, dev_batch)
+
+    requests = _serve_requests(rng, burst)
+    before = counters.get("serve_burst_oom")
+    engine._execute = failing_execute
+    try:
+        with kserve.Server(engine) as server:
+            futs = [server.submit(r) for r in requests]
+            answers = np.stack([f.result(30.0) for f in futs])
+    finally:
+        engine._execute = real_execute
+    if state["n"] < failures:
+        raise ChaosOracleError(
+            "the burst never dispatched the largest bucket — the OOM "
+            "schedule did not exercise the degradation path"
+        )
+    if counters.get("serve_burst_oom") - before < 1:
+        raise ChaosOracleError(
+            "bucket OOM was not counted under serve_burst_oom"
+        )
+    if top in engine.buckets():
+        raise ChaosOracleError(
+            f"bucket {top} survived its RESOURCE_EXHAUSTED — it must be "
+            "retired, not retried in place"
+        )
+    if not np.array_equal(answers, engine.offline(requests)):
+        raise ChaosOracleError(
+            "answers under burst OOM differ from the offline apply — "
+            "degradation changed RESULTS, not just batch shape"
+        )
+
+
 def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
     """Apply one schedule to the workload; returns the results dict (or
     raises).  Each branch is the minimal faithful injection for its
@@ -781,6 +1032,18 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "decode_worker_kill":
         _decode_worker_kill_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "slow_client":
+        _slow_client_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "malformed_request":
+        _malformed_request_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "serve_burst_oom":
+        _serve_burst_oom_phase(fault, tmpdir, seed)
         return _run_workload(workload)
 
     if fault.kind == "nan_input":

@@ -55,6 +55,12 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running end-to-end pipeline test")
     config.addinivalue_line(
         "markers",
+        "serve: serving-subsystem tests (core.serve) — tier-1 runs the "
+        "deterministic set; the concurrent-client soak is also marked "
+        "slow and runs under -m slow",
+    )
+    config.addinivalue_line(
+        "markers",
         "chaos: full seeded fault-schedule suite (tests/chaos.py) — the "
         "tier-1 run covers a small schedule; select the full set with "
         "-m chaos (full-schedule tests are also marked slow so the tier-1 "

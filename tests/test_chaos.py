@@ -28,6 +28,9 @@ EXPECTED_COUNTER = {
     "autotune_thrash": "chaos_autotune_thrash",
     "snapshot_corrupt": "snapshot_fallback",
     "decode_worker_kill": "decode_worker_respawn",
+    "slow_client": "chaos_slow_client",
+    "malformed_request": "serve_malformed_request",
+    "serve_burst_oom": "serve_burst_oom",
 }
 
 
@@ -68,6 +71,9 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # back counted-and-bit-equal, and a SIGKILLed decode worker must
     # respawn counted — never a hung ring
     assert {"snapshot_corrupt", "decode_worker_kill"} <= kinds
+    # Serving coverage (ISSUE 8): the typed-or-equal invariant extends to
+    # the online path — slow clients, malformed requests, burst OOM
+    assert set(chaos.SERVE_FAMILIES) <= kinds
 
 
 def test_schedules_are_deterministic():

@@ -1,0 +1,75 @@
+"""Import-cost discipline: ``import keystone_tpu`` must not import jax.
+
+Every spawned decode worker (core.ingest._decode_worker_main runs under
+multiprocessing spawn) re-imports the package in a fresh interpreter; the
+eager ``from .core.checkpoint import ...`` chain used to pull jax —
+multi-second startup paid per worker, visible as the bench_decode
+total-vs-steady rate gap.  The package surface is now lazy (PEP 562
+``__getattr__``) and the worker's import path (core.ingest and everything
+it imports) is jax-free at module import.  These run in SUBPROCESSES: the
+test suite's own interpreter imported jax long ago, so only a fresh
+process can observe import-time behavior.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fresh(script: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+        cwd=_REPO,
+    )
+
+
+def test_package_import_does_not_import_jax():
+    res = _fresh(
+        "import sys\n"
+        "import keystone_tpu\n"
+        "assert 'jax' not in sys.modules, 'import keystone_tpu pulled jax'\n"
+        "print('LAZY_OK', keystone_tpu.__version__)\n"
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "LAZY_OK" in res.stdout
+
+
+def test_decode_worker_import_path_does_not_import_jax():
+    """The exact modules a spawned decode worker imports (the pickle of
+    ``_decode_worker_main`` resolves keystone_tpu.core.ingest) must stay
+    jax-free — the point of the laziness is the worker spawn cost."""
+    res = _fresh(
+        "import sys\n"
+        "import keystone_tpu.core.ingest as ingest\n"
+        "assert 'jax' not in sys.modules, (\n"
+        "    'importing core.ingest pulled jax — decode workers pay it')\n"
+        "assert callable(ingest._decode_worker_main)\n"
+        "print('WORKER_LAZY_OK')\n"
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "WORKER_LAZY_OK" in res.stdout
+
+
+def test_lazy_surface_resolves_every_export():
+    """Laziness must not break the public surface: every name in __all__
+    resolves (in-process — this may import jax, which is fine here)."""
+    import keystone_tpu
+
+    for name in keystone_tpu.__all__:
+        assert getattr(keystone_tpu, name) is not None
+
+
+def test_unknown_attribute_still_raises():
+    import keystone_tpu
+
+    with pytest.raises(AttributeError):
+        keystone_tpu.definitely_not_a_symbol

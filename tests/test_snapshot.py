@@ -523,3 +523,100 @@ def test_snapshot_admin_evict_invalid_is_surgical(tmp_path, tar10, capsys):
     assert sorted(rec["removed"]) == ["snap-0000000000000000", "tmp"]
     left = ksnap.list_snapshots(root)
     assert len(left) == 1 and left[0]["valid"]
+
+
+# -- write-path compression (KEYSTONE_SNAPSHOT_COMPRESS) ----------------------
+
+
+def _write_snapshot(root, key, payloads, compress):
+    w = ksnap.SnapshotWriter(root, key, mode="decoded", compress=compress)
+    for i, p in enumerate(payloads):
+        w.add_chunk(i, np.arange(p.shape[0]) + i * p.shape[0],
+                    [f"img_{i}_{j}.jpg" for j in range(p.shape[0])], p)
+    return w.commit()
+
+
+def _read_payloads(root, key):
+    snap, status = ksnap.lookup(root, key)
+    assert status == "hit"
+    return [arrs["payload"] for _entry, arrs in snap.iter_chunks()]
+
+
+def test_compressed_shards_round_trip_bit_identical(tmp_path, rng):
+    # integral-f32 pixels: exercises the uint8 compaction + deflate combo
+    payloads = [
+        rng.integers(0, 256, (4, 8, 8, 3)).astype(np.float32)
+        for _ in range(3)
+    ]
+    root = str(tmp_path / "zcache")
+    _write_snapshot(root, "aa" * 32, payloads, compress=True)
+    got = _read_payloads(root, "aa" * 32)
+    for want, have in zip(payloads, got):
+        assert have.dtype == want.dtype
+        assert np.array_equal(want, have)
+
+
+def test_compressed_shards_are_smaller_on_compressible_payloads(tmp_path):
+    # constant-ish image data deflates hard; the manifest records both the
+    # on-disk and the raw payload bytes so the ratio is auditable
+    payloads = [np.full((8, 16, 16, 3), 127, np.float32) for _ in range(2)]
+    plain_root = str(tmp_path / "plain")
+    comp_root = str(tmp_path / "comp")
+    _write_snapshot(plain_root, "bb" * 32, payloads, compress=False)
+    _write_snapshot(comp_root, "cc" * 32, payloads, compress=True)
+
+    def shard_bytes(root, key):
+        snap, status = ksnap.lookup(root, key)
+        assert status == "hit"
+        return sum(c["bytes"] for c in snap.manifest["chunks"])
+
+    plain = shard_bytes(plain_root, "bb" * 32)
+    comp = shard_bytes(comp_root, "cc" * 32)
+    assert comp < plain / 2, (comp, plain)
+    snap, _ = ksnap.lookup(comp_root, "cc" * 32)
+    assert snap.manifest["compress"] is True
+    assert all(c["compressed"] for c in snap.manifest["chunks"])
+    assert all(c["payload_bytes"] > 0 for c in snap.manifest["chunks"])
+
+
+def test_old_uncompressed_shards_stay_readable(tmp_path, rng):
+    """A pre-knob snapshot (plain np.savez, no 'compress'/'compressed'
+    manifest fields) must keep reading under a compress-on process."""
+    payloads = [rng.integers(0, 256, (4, 8, 8, 3)).astype(np.float32)]
+    root = str(tmp_path / "old")
+    _write_snapshot(root, "dd" * 32, payloads, compress=False)
+    # Strip the new manifest fields to simulate a pre-knob artifact.
+    [snap_dir] = glob.glob(os.path.join(root, "snap-*"))
+    mpath = os.path.join(snap_dir, ksnap.MANIFEST_NAME)
+    with open(mpath) as fh:
+        manifest = json.load(fh)
+    manifest.pop("compress", None)
+    chunks = []
+    for c in manifest["chunks"]:
+        c = dict(c)
+        c.pop("compressed", None)
+        c.pop("payload_bytes", None)
+        chunks.append(c)
+    manifest["chunks"] = chunks
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh)
+    prev = os.environ.get(ksnap.SNAPSHOT_COMPRESS_ENV)
+    os.environ[ksnap.SNAPSHOT_COMPRESS_ENV] = "1"
+    try:
+        got = _read_payloads(root, "dd" * 32)
+    finally:
+        if prev is None:
+            os.environ.pop(ksnap.SNAPSHOT_COMPRESS_ENV, None)
+        else:
+            os.environ[ksnap.SNAPSHOT_COMPRESS_ENV] = prev
+    assert np.array_equal(got[0], payloads[0])
+
+
+def test_compress_env_knob(tmp_path, monkeypatch):
+    monkeypatch.delenv(ksnap.SNAPSHOT_COMPRESS_ENV, raising=False)
+    assert ksnap.snapshot_compress_env() is True  # default on
+    monkeypatch.setenv(ksnap.SNAPSHOT_COMPRESS_ENV, "0")
+    assert ksnap.snapshot_compress_env() is False
+    w = ksnap.SnapshotWriter(str(tmp_path), "ee" * 32, mode="decoded")
+    assert w._compress is False  # writer defers to the env when unpinned
+    w.abort()

@@ -58,6 +58,13 @@ def main(argv=None) -> int:
         "snapshot_corrupt / decode_worker_kill families, core.ingest + "
         "core.snapshot paths)",
     )
+    p.add_argument(
+        "--serve",
+        action="store_true",
+        help="run only the serving fault schedules (slow_client / "
+        "malformed_request / serve_burst_oom families, the core.serve "
+        "online path)",
+    )
     p.add_argument("--workload", default="mnist", choices=("mnist", "cifar"))
     p.add_argument(
         "--trace",
@@ -75,21 +82,25 @@ def main(argv=None) -> int:
         seeds = (a.seed,)
     else:
         seeds = chaos.FULL_SEEDS if a.full else chaos.TIER1_SEEDS
-    if a.stream:
+    if a.stream or a.serve:
 
-        def is_stream(seed: int) -> bool:
+        def selected(seed: int) -> bool:
             kind = chaos.make_schedule(seed).kind
-            return kind.startswith("stream_") or kind in (
-                "autotune_thrash", "snapshot_corrupt", "decode_worker_kill",
-            )
+            if a.stream and (
+                kind.startswith("stream_")
+                or kind
+                in ("autotune_thrash", "snapshot_corrupt", "decode_worker_kill")
+            ):
+                return True
+            return a.serve and kind in chaos.SERVE_FAMILIES
 
         seeds = tuple(
             s
             for s in (chaos.FULL_SEEDS if a.seed is None else seeds)
-            if is_stream(s)
+            if selected(s)
         )
         if not seeds:
-            print("no streaming schedules in the selected seed set")
+            print("no matching schedules in the selected seed set")
             return 1
 
     if a.trace is not None:
