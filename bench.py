@@ -732,7 +732,7 @@ def bench_stage_ops(rng):
     return out
 
 
-def bench_solve_at_scale(rng):
+def bench_solve_at_scale(rng, shapes=None, bwls_shapes=None, bs=4096):
     """The BCD solve at the largest single-chip-HBM shape that fits
     (VERDICT r4 #2, r5 #1): the flagship one-program claim exercised where
     memory behavior actually matters.  Round-7 discipline (ISSUE 7
@@ -751,17 +751,21 @@ def bench_solve_at_scale(rng):
     largest shape the ladder lands, with the mesh path scaling
     rows/classes out.
     """
+    from keystone_tpu.core import autoshard
     from keystone_tpu.core import memory as kmem
 
+    # Synthetic fixed-seed probes: never read or train the real plan log,
+    # even on direct invocation.
+    autoshard.hermetic_plan_log()
     k_cls = 128
-    bs = 4096
-    shapes = [  # (n, d) descending footprint; ~GB = n*d*4/2**30
-        (262144, 16384),  # 16.0 GB design matrix — expected deny, recorded
-        (196608, 16384),  # 12.0 GB
-        (163840, 16384),  # 10.0 GB
-        (131072, 16384),  # 8.0 GB
-        (131072, 8192),   # 4.0 GB
-    ]
+    if shapes is None:
+        shapes = [  # (n, d) descending footprint; ~GB = n*d*4/2**30
+            (262144, 16384),  # 16.0 GB design matrix — expected deny
+            (196608, 16384),  # 12.0 GB
+            (163840, 16384),  # 10.0 GB
+            (131072, 16384),  # 8.0 GB
+            (131072, 8192),   # 4.0 GB
+        ]
     budget = kmem.hbm_budget()
     attempts = []
     result = None
@@ -827,7 +831,9 @@ def bench_solve_at_scale(rng):
         return {
             "error": "no probed shape fit",
             "attempts": attempts,
-            "bwls": _guarded(_bench_bwls_at_scale, rng),
+            "bwls": _guarded(
+                lambda r: _bench_bwls_at_scale(r, shapes=bwls_shapes), rng
+            ),
         }
     result["oom_attempts"] = attempts
     # Release this probe's device buffers and drop every probed shape's
@@ -837,11 +843,13 @@ def bench_solve_at_scale(rng):
     # nested probe on 16 GB-HBM chips (ADVICE r5).
     x = y = None  # noqa: F841
     kmem.clear_plan_cache()
-    result["bwls"] = _guarded(_bench_bwls_at_scale, rng)
+    result["bwls"] = _guarded(
+        lambda r: _bench_bwls_at_scale(r, shapes=bwls_shapes), rng
+    )
     return result
 
 
-def _bench_bwls_at_scale(rng):
+def _bench_bwls_at_scale(rng, shapes=None, bs=4096):
     """The whole class-weighted fit at HBM-stressing scale (VERDICT r4 #2,
     r5 #1), probed through the estimator's OWN admission-control ladder:
     each shape's fit preflights fused/stepwise/host-staged tiers, runs the
@@ -852,19 +860,20 @@ def _bench_bwls_at_scale(rng):
     from keystone_tpu.solvers.weighted import BlockWeightedLeastSquaresEstimator
 
     c = 256
-    shapes = [  # (n, d) descending footprint
-        (131072, 16384),  # 8.0 GB design matrix
-        (131072, 8192),   # 4.0 GB
-    ]
+    if shapes is None:
+        shapes = [  # (n, d) descending footprint
+            (131072, 16384),  # 8.0 GB design matrix
+            (131072, 8192),   # 4.0 GB
+        ]
     attempts = []
     result = None
     for n, d in shapes:
         rec = {
-            "n": n, "d": d, "classes": c, "block_size": 4096,
+            "n": n, "d": d, "classes": c, "block_size": bs,
             "design_matrix_gb": round(n * d * 4 / 2**30, 2),
         }
         est = BlockWeightedLeastSquaresEstimator(
-            4096, num_iter=1, lam=0.01, mixture_weight=0.25
+            bs, num_iter=1, lam=0.01, mixture_weight=0.25
         )
         try:
             key = jax.random.PRNGKey(11 + d % 13)
@@ -907,6 +916,100 @@ def _bench_bwls_at_scale(rng):
         return {"error": "no probed shape fit", "attempts": attempts}
     result["attempts"] = attempts
     return result
+
+
+def bench_placement(rng):
+    """Placement-search section (ISSUE 9): the cost-model-ranked plan
+    (core.autoshard) vs the hand-enumerated ladder on the SAME BCD solve,
+    across >= 3 design-matrix shapes.
+
+    Per shape, both fits run on identical inputs after a shared warmup fit
+    (so neither pays first-compile costs the other skips): ``hand`` walks
+    the hand ladder (``plan=False``), ``searched`` runs the ranked
+    candidate list (``plan=True``).  The acceptance bars: the searched
+    fit's model is BIT-IDENTICAL to the hand fit's (an untrained cost
+    model never deviates from the proven default), its wall is <= the hand
+    wall within noise, and the search overhead (``search_seconds`` — the
+    enumerate + prune + score pass, no compiles) stays under 5% of the fit
+    wall.  ``prediction_error`` is the chosen plan's predicted/measured
+    ratio — the figure the plan-outcome log's learned calibration drives
+    toward 1.0 across runs.
+    """
+    from keystone_tpu.core import autoshard
+    from keystone_tpu.core import memory as kmem
+
+    # Even when invoked directly (the verify one-liner), this section's
+    # fixed-rng fits must not read or train the operator's real plan log.
+    autoshard.hermetic_plan_log()
+    k_cls = 64
+    bs = 1024
+    shapes = [(16384, 2048), (8192, 4096), (32768, 1024)]
+    rows = []
+    for n, d in shapes:
+        x = jnp.asarray(rng.standard_normal((n, d), dtype=np.float32))
+        y = jnp.asarray(
+            2.0 * np.eye(k_cls, dtype=np.float32)[
+                rng.integers(0, k_cls, n)
+            ] - 1.0
+        )
+
+        def one_fit(plan, n=n):
+            est = BlockLeastSquaresEstimator(bs, num_iter=1, lam=10.0)
+            t0 = time.perf_counter()
+            model = est.fit(x, y, plan=plan)
+            float(  # scalar pull = the one sync this transport honors
+                sum(jnp.sum(b) for b in model.xs)
+                + jnp.sum(jnp.asarray(model.b))
+            )
+            return time.perf_counter() - t0, model, est.last_fit_report
+
+        one_fit(False)  # shared warmup: compiles cached for both timed fits
+        hand_wall, hand_model, hand_rep = one_fit(False)
+        srch_wall, srch_model, srch_rep = one_fit(True)
+        bit_identical = bool(
+            np.array_equal(np.asarray(hand_model.b), np.asarray(srch_model.b))
+            and all(
+                np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(hand_model.xs, srch_model.xs)
+            )
+        )
+        placement = srch_rep.placement if srch_rep is not None else None
+        rows.append({
+            "n": n, "d": d, "block_size": bs, "classes": k_cls,
+            "hand_wall_seconds": round(hand_wall, 4),
+            "searched_wall_seconds": round(srch_wall, 4),
+            "searched_vs_hand": round(srch_wall / hand_wall, 4),
+            "hand_chosen": hand_rep.chosen if hand_rep is not None else None,
+            "searched_chosen": (
+                srch_rep.chosen if srch_rep is not None else None
+            ),
+            "predictions_bit_identical": bit_identical,
+            "search_seconds": (
+                placement["search_seconds"] if placement else None
+            ),
+            "search_overhead_frac": (
+                round(placement["search_seconds"] / srch_wall, 5)
+                if placement else None
+            ),
+            "prediction_error": (
+                placement["prediction_error"] if placement else None
+            ),
+            "candidates": len(placement["candidates"]) if placement else 0,
+            "pruned": (
+                sum(1 for c in placement["candidates"] if c["pruned"])
+                if placement else 0
+            ),
+            "ranking": placement["ranking"] if placement else None,
+        })
+        hand_model = srch_model = x = y = None  # noqa: F841 — free HBM
+        kmem.clear_plan_cache()
+    return {
+        "shapes": rows,
+        "all_bit_identical": all(r["predictions_bit_identical"] for r in rows),
+        "max_search_overhead_frac": max(
+            (r["search_overhead_frac"] or 0.0) for r in rows
+        ),
+    }
 
 
 def bench_e2e_ingest(rng):
@@ -1542,6 +1645,15 @@ def _guarded(fn, rng):
 
 
 def main():
+    from keystone_tpu.core import autoshard
+
+    # Hermetic placement search: the bench asserts searched-vs-hand
+    # bit-equality and ranking-dependent bars that a TRAINED operator log
+    # (~/.keystone_plans.jsonl) could legitimately reorder, and its
+    # synthetic shapes must not pollute the log that calibrates real
+    # workload fits.  Each bench process gets a throwaway log (the
+    # placement/at-scale sections also pin one for direct invocations).
+    autoshard.hermetic_plan_log()
     rng = np.random.default_rng(0)
     n_chips = len(jax.devices())
     kind = jax.devices()[0].device_kind
@@ -1555,6 +1667,7 @@ def main():
     e2e = _guarded(bench_e2e_ingest, rng)
     optimizer = _guarded(bench_optimizer, rng)
     serving = _guarded(bench_serving, rng)
+    placement = _guarded(bench_placement, rng)
     at_scale = _guarded(bench_solve_at_scale, rng)
 
     # ONE atomic registry snapshot feeds both the back-compat "faults" key
@@ -1641,6 +1754,12 @@ def main():
             # sustained QPS, batcher occupancy, batched-vs-unbatched QPS
             # (>= 2x target at bit-equal answers).
             "serving": serving,
+            # Placement search (core.autoshard): searched-vs-hand-ladder
+            # fit wall on >= 3 BCD shapes (bit-identical models required),
+            # the search's enumerate+prune+score overhead as a fraction of
+            # fit wall (< 5% bar), and the chosen plan's
+            # predicted-vs-measured cost ratio.
+            "placement": placement,
         },
     }
     # Artifact-truncation guard (VERDICT r5 "Driver artifacts"): the driver

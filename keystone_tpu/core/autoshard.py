@@ -1,0 +1,754 @@
+"""Automated sharding/placement search: cost-model-ranked plans replace the
+hand-enumerated ladders.
+
+The degradation ladders (core.memory.run_ladder) encode placement as two
+hand-written lists: fused -> stepwise -> host-staged on one device, full
+mesh -> collapsed mesh -> single device across chips.  That is KeystoneML's
+pre-optimizer posture — operator choices written down instead of searched.
+This module is the whole-pipeline-optimizer treatment for PLACEMENT
+(Automap and the Learned Cost Model placement paper, PAPERS.md): given a
+solve's candidate executions — every (data, model) factorization of the
+live device set (parallel.mesh.enumerate_mesh_shapes) x sharding spec per
+operand (from the program's avals, :func:`spec_candidates`) x execution
+strategy (fused / stepwise / host-staged) — the search
+
+1. **prunes** candidates with the zero-cost analytic batch preflight
+   (core.memory.plan_bytes / plan_batch — no compile; a denied plan is
+   free to reject, and the full compiled admission still guards whatever
+   the ladder later selects);
+2. **scores** survivors with the shared cost model
+   (core.optimize.CostModel): an analytic roofline prior over per-chip
+   bytes / FLOPs / dispatches / collective volume, multiplied by a learned
+   per-(program, candidate) calibration fitted to MEASURED outcomes from
+   the persistent plan-outcome log (``~/.keystone_plans.jsonl``, keyed by
+   program fingerprint) — the model improves across runs;
+3. **ranks** with a confidence margin: candidates whose predicted costs
+   are within one margin FACTOR of the cheapest remaining candidate keep
+   their prior (hand-ladder) order (:data:`UNTRAINED_MARGIN` cold,
+   :data:`TRAINED_MARGIN` for pairs where BOTH sides carry >=
+   :data:`MIN_TRAIN` direct measurements) — an untrained prior never
+   deviates from the proven default on noise, so a searched fit is
+   bit-identical to the hand ladder until real measurements argue
+   otherwise; the resilience floor is pinned last regardless of score;
+4. **runs** the ranked list through the SAME ``run_ladder`` contract the
+   hand ladders use — per-tier compiled admission at selection, runtime
+   RESOURCE_EXHAUSTED steps down the RANKED list one plan at a time
+   (counted ``autoshard_stepdown``), typed errors propagate — and lands
+   the full candidate table, deny/score rationale, and predicted-vs-actual
+   cost of the chosen plan in the :class:`PlacementPlan` attached to the
+   solver's ``FitReport``.
+
+``KEYSTONE_AUTOSHARD=0`` restores the hand ladders; ``fit(plan=...)``
+overrides per call (``False`` hand, ``True`` force search, a
+:class:`PlacementPlan` or name list replays a previous ranking).
+``KEYSTONE_PLAN_LOG`` points the outcome log elsewhere (``off`` disables).
+The log is read ONCE per process: outcomes appended during a run train the
+NEXT process, so a ranking can never silently change between a baseline
+and a comparison fit inside one process (the chaos bit-equality bar).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from . import memory as kmem
+from . import optimize as kopt
+from . import trace
+from .resilience import counters
+
+_logger = logging.getLogger("keystone_tpu.autoshard")
+
+#: env var: "0"/"off"/"false" restores the hand ladders process-wide.
+AUTOSHARD_ENV = "KEYSTONE_AUTOSHARD"
+
+#: env var: plan-outcome log path; default ``~/.keystone_plans.jsonl``;
+#: "0"/"off"/"none" disables persistence.
+PLAN_LOG_ENV = "KEYSTONE_PLAN_LOG"
+_DEFAULT_PLAN_LOG = "~/.keystone_plans.jsonl"
+
+#: measurements per (fingerprint, candidate) before its calibration counts.
+MIN_TRAIN = 3
+#: cold-start ranking margin: an untrained analytic score must beat the
+#: cheapest remaining candidate by this FACTOR before reordering past a
+#: prior-earlier plan — the guarantee that a searched fit without
+#: measurements reproduces the hand ladder's choice bit-for-bit.
+UNTRAINED_MARGIN = 4.0
+#: margin for a pair of candidates that BOTH carry >= MIN_TRAIN direct
+#: measured outcomes — only like-for-like measured comparisons get the
+#: tight margin; any pair with an unmeasured side keeps the cold one.
+TRAINED_MARGIN = 1.15
+
+#: bound on how much of the log one process will read back (newest wins).
+_MAX_LOG_RECORDS = 50_000
+
+
+def enabled() -> bool:
+    """Search is the default; ``KEYSTONE_AUTOSHARD=0`` restores the hand
+    ladders."""
+    return os.environ.get(AUTOSHARD_ENV, "").strip().lower() not in (
+        "0", "off", "false",
+    )
+
+
+# -- program fingerprints ------------------------------------------------------
+
+
+def fingerprint(label: str, *parts) -> str:
+    """Stable 16-hex-char fingerprint of a solve program's cost identity:
+    the label plus whatever shapes/dtypes/statics/device description the
+    caller folds in.  Same fingerprint => the plan log's measurements are
+    comparable => same ranking under a fixed device set (the determinism
+    contract the tests pin)."""
+    blob = json.dumps([label, *map(str, parts)], sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def device_fingerprint(devices=None) -> str:
+    """``'cpu x8'``-style description of the device set a plan assumed."""
+    try:
+        if devices is None:
+            import jax
+
+            devices = jax.devices()
+        devices = list(devices)
+        kind = getattr(devices[0], "device_kind", "unknown")
+        return f"{kind} x{len(devices)}"
+    except Exception:  # noqa: BLE001 — no backend yet
+        return "unknown x0"
+
+
+# -- sharding-spec enumeration from avals --------------------------------------
+
+
+def spec_candidates(aval, mesh_shape: dict) -> list[dict]:
+    """Candidate shardings for ONE operand aval under a (data, model) mesh
+    shape — generated from the aval's dimensions, not a hand list: the data
+    axis over any evenly-divisible dim, the model axis over any other
+    evenly-divisible dim, and replicated (always legal).  Each entry
+    carries the spec's per-chip bytes, the quantity the cost model charges.
+    """
+    shape = tuple(int(d) for d in aval.shape)
+    itemsize = np.dtype(aval.dtype).itemsize
+    total = int(np.prod(shape)) * itemsize if shape else itemsize
+    out = [{"spec": "replicated", "per_chip_bytes": total}]
+    d_sz = int(mesh_shape.get("data", 1))
+    m_sz = int(mesh_shape.get("model", 1))
+    for dim, n in enumerate(shape):
+        if d_sz > 1 and n % d_sz == 0:
+            out.append({
+                "spec": f"data@dim{dim}",
+                "per_chip_bytes": total // d_sz,
+            })
+        if m_sz > 1 and n % m_sz == 0:
+            out.append({
+                "spec": f"model@dim{dim}",
+                "per_chip_bytes": total // m_sz,
+            })
+    return out
+
+
+def best_spec(aval, mesh_shape: dict) -> dict:
+    """The minimum-per-chip-bytes legal sharding for one aval — what the
+    analytic byte accounting assumes a candidate mesh can achieve for a
+    shardable operand (replicated when nothing divides)."""
+    cands = spec_candidates(aval, mesh_shape)
+    return min(cands, key=lambda c: (c["per_chip_bytes"], c["spec"]))
+
+
+# -- the plan-outcome log ------------------------------------------------------
+
+
+def plan_log_path() -> str | None:
+    raw = os.environ.get(PLAN_LOG_ENV, "").strip()
+    if raw.lower() in ("0", "off", "none"):
+        return None
+    return os.path.expanduser(raw or _DEFAULT_PLAN_LOG)
+
+
+def hermetic_plan_log() -> str:
+    """Point the plan-outcome log at a fresh throwaway file and forget any
+    cached read.  For measurement/chaos drivers (bench sections,
+    tools/chaos_run.py): their fixed-seed synthetic fits must neither
+    TRAIN the operator's real log (three bench rounds would calibrate the
+    bench fingerprints and start reordering the very ranking the driver
+    asserts is hand-identical) nor evict real workload records from its
+    bounded tail."""
+    import tempfile
+
+    path = os.path.join(
+        tempfile.mkdtemp(prefix="keystone_plans_hermetic_"), "plans.jsonl"
+    )
+    os.environ[PLAN_LOG_ENV] = path
+    clear_outcome_cache()
+    return path
+
+
+def append_outcome(record: dict) -> None:
+    """Best-effort append of one plan outcome to the persistent log.  A
+    broken log path degrades counted (``plan_log_write_failed``) — the
+    solve's result never depends on telemetry landing."""
+    path = plan_log_path()
+    if path is None:
+        return
+    try:
+        parent = os.path.dirname(os.path.abspath(path))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError as e:
+        counters.record("plan_log_write_failed", f"{path}: {e}")
+
+
+#: path -> parsed records, filled once per process (see module docstring:
+#: in-process stability is what keeps baseline-vs-faulted comparisons
+#: bit-equal; fresh measurements train the NEXT process).
+_outcome_cache: dict[str, list] = {}
+
+
+def load_outcomes(path: str | None = None) -> list[dict]:
+    path = path if path is not None else plan_log_path()
+    if path is None:
+        return []
+    cached = _outcome_cache.get(path)
+    if cached is not None:
+        return cached
+    records: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # a torn tail line is not an error
+    except OSError:
+        records = []
+    records = records[-_MAX_LOG_RECORDS:]
+    _outcome_cache[path] = records
+    return records
+
+
+def clear_outcome_cache() -> None:
+    """Test seam: forget the once-per-process log read."""
+    _outcome_cache.clear()
+    _ratio_cache.clear()
+
+
+#: path -> ({(fingerprint, candidate): ratios}, {fingerprint: ratios}) —
+#: one pass over the log per process instead of a rescan per candidate
+#: (the search's O(candidates) calibration lookups must stay O(1) against
+#: a log grown toward _MAX_LOG_RECORDS, or the scan itself would eat the
+#: <5% search-overhead budget).
+_ratio_cache: dict[str, tuple[dict, dict]] = {}
+
+
+def _ratio_index(path: str | None) -> tuple[dict, dict]:
+    key = path if path is not None else (plan_log_path() or "")
+    cached = _ratio_cache.get(key)
+    if cached is not None:
+        return cached
+    by_pair: dict = {}
+    by_fp: dict = {}
+    for r in load_outcomes(path):
+        if not (
+            r.get("outcome") == "ok"
+            and r.get("predicted_seconds")
+            and r.get("measured_seconds")
+        ):
+            continue
+        ratio = r["measured_seconds"] / r["predicted_seconds"]
+        fp = r.get("fingerprint")
+        by_pair.setdefault((fp, r.get("candidate")), []).append(ratio)
+        by_fp.setdefault(fp, []).append(ratio)
+    _ratio_cache[key] = (by_pair, by_fp)
+    return by_pair, by_fp
+
+
+def calibration(fp: str, candidate: str, path: str | None = None) -> tuple[float, int]:
+    """``(factor, direct_samples)`` for one (fingerprint, candidate) pair:
+    the median measured/predicted ratio over the log's successful outcomes.
+
+    Training is one-sided — only plans that actually RAN log outcomes — so
+    below :data:`MIN_TRAIN` direct samples the factor falls back to the
+    PROGRAM-level median (every candidate of the fingerprint pooled): a
+    CONSTANT factor across all uncalibrated siblings, which shifts their
+    absolute predictions toward honesty without ever reordering them.
+    Without the fallback, the measured winner would absorb its real
+    slowdown while unmeasured competitors kept optimistic raw priors, and
+    the ranking would drift toward whatever never ran.  The returned
+    sample count is the DIRECT count — it drives the per-pair trained
+    margin, which a pooled fallback must not tighten."""
+    by_pair, by_fp = _ratio_index(path)
+    direct = by_pair.get((fp, candidate), ())
+    if len(direct) >= MIN_TRAIN:
+        return float(np.median(direct)), len(direct)
+    pooled = by_fp.get(fp, ())
+    if len(pooled) >= MIN_TRAIN:
+        return float(np.median(pooled)), len(direct)
+    return 1.0, len(direct)
+
+
+# -- candidates and the plan record --------------------------------------------
+
+
+@dataclasses.dataclass
+class Candidate:
+    """One executable placement: a mesh shape (or none) x execution
+    strategy, with the lazy compiled preflight / run closures the ladder
+    consumes and the analytic cost hints the search scores."""
+
+    name: str
+    kind: str  #: "fused_mesh" | "fused" | "stepwise" | "host_staged" | ...
+    plan: Callable[[], "kmem.MemoryPlan"]
+    run: Callable[["kmem.MemoryPlan"], Any]
+    #: analytic per-chip cost hints (CostModel.predict_seconds keys) plus
+    #: the prune figures plan_bytes charges (arg/temp/out/extra/resident).
+    hints: dict = dataclasses.field(default_factory=dict)
+    mesh_axes: dict | None = None
+    prior_rank: int = 0  #: hand-ladder position (ties resolve to this)
+    floor: bool = False  #: the resilience backstop — always ranked last
+    hand: bool = True  #: hand-ladder member (its prunes land in FitReport)
+
+
+@dataclasses.dataclass
+class CandidateRecord:
+    """One row of the plan's candidate table — the deny/score rationale."""
+
+    name: str
+    kind: str
+    mesh: dict | None
+    prior_rank: int
+    pruned: bool
+    reason: str  #: deny reason when pruned, score rationale otherwise
+    predicted_seconds: float | None = None
+    raw_seconds: float | None = None  #: analytic prior before calibration
+    calibration: float = 1.0
+    samples: int = 0  #: measured outcomes behind the calibration
+    rank: int | None = None  #: position in the execution ranking
+    measured_seconds: float | None = None  #: filled when this plan RAN
+    outcome: str | None = None  #: "ok" | "oom" | "denied" after the run
+
+    def record(self) -> dict:
+        out = dataclasses.asdict(self)
+        for k in ("predicted_seconds", "raw_seconds", "measured_seconds"):
+            if out[k] is not None:
+                out[k] = round(out[k], 6)
+        out["calibration"] = round(self.calibration, 4)
+        return out
+
+
+@dataclasses.dataclass
+class PlacementPlan:
+    """The search's audit trail (FitReport's placement leg): every
+    enumerated candidate with its deny/score rationale, the ranking that
+    actually executed, and the chosen plan's predicted-vs-actual cost."""
+
+    label: str
+    fingerprint: str
+    devices: str
+    trained: bool
+    margin: float
+    candidates: list  #: list[CandidateRecord], prior order
+    ranking: list  #: candidate names, execution order (floor last)
+    search_seconds: float = 0.0
+    chosen: str | None = None
+    predicted_seconds: float | None = None
+    measured_seconds: float | None = None
+    prediction_error: float | None = None  #: predicted / measured
+    #: name -> the zero-cost analytic MemoryPlan the batch preflight
+    #: produced (pruned candidates hand it straight to the ladder walk —
+    #: a pruned plan is denied for free, never re-planned or compiled).
+    analytic_plans: dict = dataclasses.field(
+        default_factory=dict, repr=False, compare=False
+    )
+
+    def candidate(self, name: str) -> CandidateRecord | None:
+        for c in self.candidates:
+            if c.name == name:
+                return c
+        return None
+
+    def record(self) -> dict:
+        return {
+            "label": self.label,
+            "fingerprint": self.fingerprint,
+            "devices": self.devices,
+            "trained": self.trained,
+            "margin": self.margin,
+            "search_seconds": round(self.search_seconds, 6),
+            "ranking": list(self.ranking),
+            "chosen": self.chosen,
+            "predicted_seconds": (
+                round(self.predicted_seconds, 6)
+                if self.predicted_seconds is not None else None
+            ),
+            "measured_seconds": (
+                round(self.measured_seconds, 6)
+                if self.measured_seconds is not None else None
+            ),
+            "prediction_error": (
+                round(self.prediction_error, 4)
+                if self.prediction_error is not None else None
+            ),
+            "candidates": [c.record() for c in self.candidates],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.record())
+
+    def summary(self) -> str:
+        s = (
+            f"autoshard {self.label}[{self.fingerprint}]: "
+            f"{len(self.ranking)}/{len(self.candidates)} candidates ranked"
+            f" ({'trained' if self.trained else 'untrained'} margin "
+            f"{self.margin}x), head={self.ranking[0] if self.ranking else None}"
+        )
+        if self.chosen is not None:
+            s += f", chosen={self.chosen}"
+        if self.prediction_error is not None:
+            s += f", prediction_error={self.prediction_error:.2f}x"
+        return s
+
+
+# -- search + ranked execution -------------------------------------------------
+
+
+def _margin_order(body: list) -> list:
+    """Margin-aware selection order over ``(Candidate, CandidateRecord)``
+    pairs: at each step, among the remaining candidates whose predicted
+    cost is within the confidence margin of the CHEAPEST remaining one,
+    the lowest prior (hand) rank wins.  Relative margins (not absolute
+    buckets — two scores a hair apart must never split across a bucket
+    edge and reorder) and per-pair trained-ness: the tight
+    :data:`TRAINED_MARGIN` applies only when BOTH the candidate and the
+    cheapest one carry >= :data:`MIN_TRAIN` direct measurements."""
+    ordered: list = []
+    remaining = sorted(body, key=lambda sr: sr[1].prior_rank)
+    while remaining:
+        best = min(remaining, key=lambda sr: (sr[1].predicted_seconds,
+                                              sr[1].prior_rank))
+        def margin(sr, best=best):
+            both_trained = (
+                sr[1].samples >= MIN_TRAIN and best[1].samples >= MIN_TRAIN
+            )
+            return TRAINED_MARGIN if both_trained else UNTRAINED_MARGIN
+
+        pick = min(
+            (
+                sr for sr in remaining
+                if sr[1].predicted_seconds
+                <= best[1].predicted_seconds * margin(sr)
+            ),
+            key=lambda sr: sr[1].prior_rank,
+        )
+        ordered.append(pick)
+        remaining.remove(pick)
+    return ordered
+
+
+def search(
+    label: str,
+    candidates: Sequence[Candidate],
+    *,
+    fingerprint: str,
+    budget: int | None | object = kmem._UNSET,
+    model: "kopt.CostModel | None" = None,
+) -> PlacementPlan:
+    """Enumerate -> prune -> score -> rank.  Pure decision pass: nothing is
+    compiled and nothing runs — see :func:`run_search` for execution."""
+    t0 = time.perf_counter()
+    model = model if model is not None else kopt.CostModel.for_devices()
+    records: list[CandidateRecord] = []
+    survivors: list[tuple[Candidate, CandidateRecord]] = []
+    with trace.span("autoshard.search", cat="plan", label=label):
+        # 1. zero-cost batch preflight: analytic per-chip bytes vs budget.
+        analytic = kmem.plan_batch([
+            (
+                c.name,
+                lambda c=c: kmem.plan_bytes(
+                    f"autoshard:{c.name}",
+                    # LOWER bound of the compiled admission (see
+                    # plan_bytes): donated/aliased argument bytes are
+                    # credited out so the prune can never deny a plan the
+                    # full preflight would admit.
+                    argument_bytes=max(
+                        0,
+                        c.hints.get("arg_bytes", 0)
+                        - c.hints.get("alias_bytes", 0),
+                    ),
+                    temp_bytes=c.hints.get("temp_bytes", 0),
+                    extra_bytes=c.hints.get("extra_bytes", 0),
+                    resident_bytes=c.hints.get("resident_bytes", 0),
+                    budget=budget,
+                ),
+            )
+            for c in candidates
+        ])
+        trained = True
+        for c in candidates:
+            mp = analytic[c.name]
+            rec = CandidateRecord(
+                name=c.name,
+                kind=c.kind,
+                mesh=dict(c.mesh_axes) if c.mesh_axes else None,
+                prior_rank=c.prior_rank,
+                pruned=not mp.admitted and not c.floor,
+                reason=mp.reason,
+            )
+            records.append(rec)
+            if rec.pruned:
+                rec.outcome = "denied"
+                continue
+            # 2. score: analytic roofline prior x learned calibration.
+            raw = model.predict_seconds(c.hints)
+            factor, samples = calibration(fingerprint, c.name)
+            rec.raw_seconds = raw
+            rec.calibration = factor
+            rec.samples = samples
+            rec.predicted_seconds = raw * factor
+            if samples < MIN_TRAIN:
+                trained = False
+            survivors.append((c, rec))
+        # 3. rank: within-margin candidates keep their prior order (the
+        # tight margin only for measured-vs-measured pairs), floor pinned
+        # last.  ``margin`` on the plan reports the factor the HEAD
+        # comparison got.
+        margin = TRAINED_MARGIN if trained and survivors else UNTRAINED_MARGIN
+        body = [sr for sr in survivors if not sr[0].floor]
+        floor = [sr for sr in survivors if sr[0].floor]
+        ordered = _margin_order(body) + sorted(
+            floor, key=lambda sr: sr[1].prior_rank
+        )
+        for i, (c, rec) in enumerate(ordered):
+            rec.reason = (
+                f"rank {i}: predicted {rec.predicted_seconds:.4g}s "
+                f"(prior {rec.raw_seconds:.4g}s x calibration "
+                f"{rec.calibration:.3g} from {rec.samples} outcome(s))"
+                + (" [floor: pinned last]" if c.floor else "")
+            )
+        # Pruned HAND candidates stay in the execution order at their hand
+        # position (their cached analytic deny is handed to the ladder walk
+        # — rejected for free, and the FitReport's denial ORDER matches the
+        # hand contract exactly).  Pruned EXTRA candidates are dropped: the
+        # search enumerated them, the placement table shows why they lost,
+        # and the hand report's shape stays untouched.
+        ranking: list[tuple] = list(ordered)
+        by_name = {c.name: c for c in candidates}
+        pruned_hand = [
+            r for r in records if r.pruned and by_name[r.name].hand
+        ]
+        for rec in sorted(pruned_hand, key=lambda r: r.prior_rank):
+            at = len(ranking)
+            for i, (rc, _rrec) in enumerate(ranking):
+                if rc.floor or (rc.hand and rc.prior_rank > rec.prior_rank):
+                    at = i
+                    break
+            ranking.insert(at, (by_name[rec.name], rec))
+        for i, (_c, rec) in enumerate(ranking):
+            rec.rank = i
+    plan = PlacementPlan(
+        label=label,
+        fingerprint=fingerprint,
+        devices=device_fingerprint(),
+        trained=trained,
+        margin=margin if survivors else UNTRAINED_MARGIN,
+        candidates=records,
+        ranking=[rec.name for _, rec in ranking],
+        search_seconds=time.perf_counter() - t0,
+        analytic_plans={
+            rec.name: analytic[rec.name] for rec in records if rec.pruned
+        },
+    )
+    trace.instant(
+        "autoshard_plan",
+        label=label,
+        fingerprint=fingerprint,
+        ranking=plan.ranking,
+        pruned=[r.name for r in records if r.pruned],
+        trained=trained,
+    )
+    _logger.info("%s", plan.summary())
+    return plan
+
+
+def will_search(plan_arg) -> bool:
+    """Whether ``fit(plan=plan_arg)`` will run the placement search — the
+    solvers' guard for skipping candidate-enumeration work (building a
+    jax Mesh per device factorization) that a hand-ladder walk would
+    discard unused."""
+    return _resolve(plan_arg)[0]
+
+
+def _resolve(plan_arg) -> tuple[bool, list | None]:
+    """``fit(plan=...)`` semantics -> (search?, forced ranking names)."""
+    if plan_arg is None:
+        return enabled(), None
+    if plan_arg is False:
+        return False, None
+    if plan_arg is True:
+        return True, None
+    if isinstance(plan_arg, PlacementPlan):
+        return True, list(plan_arg.ranking)
+    if isinstance(plan_arg, (list, tuple)):
+        return True, [str(n) for n in plan_arg]
+    raise TypeError(
+        f"fit(plan=...) wants None/bool/PlacementPlan/name list, got "
+        f"{type(plan_arg).__name__}"
+    )
+
+
+def run_search(
+    label: str,
+    candidates: Sequence[Candidate],
+    report: "kmem.FitReport",
+    *,
+    fingerprint: str,
+    plan=None,
+    budget: int | None | object = kmem._UNSET,
+    model: "kopt.CostModel | None" = None,
+):
+    """The solvers' one entry point: search (or honor the ``plan``
+    override), then drive the RANKED candidate list through
+    ``core.memory.run_ladder`` — the same per-tier compiled admission and
+    one-plan-at-a-time OOM step-down contract the hand ladders obey, now
+    over the searched order.  Attaches the finished :class:`PlacementPlan`
+    record to ``report.placement``, appends outcomes to the plan log, and
+    counts every step off the top-ranked plan under ``autoshard_stepdown``.
+    """
+    do_search, forced = _resolve(plan)
+    by_prior = sorted(candidates, key=lambda c: c.prior_rank)
+    if not do_search:
+        tiers = [
+            kmem.Tier(c.name, c.plan, c.run)
+            for c in by_prior
+            if c.hand  # the hand ladder is exactly the hand candidates
+        ]
+        return kmem.run_ladder(label, tiers, report)
+
+    placement = search(
+        label, candidates, fingerprint=fingerprint, budget=budget, model=model
+    )
+    if forced is not None:
+        known = {c.name for c in candidates}
+        ranking = [n for n in forced if n in known]
+        # anything the override did not name keeps its searched order
+        ranking += [n for n in placement.ranking if n not in ranking]
+        # the floor stays the backstop even under a forced ranking
+        floors = [c.name for c in by_prior if c.floor and c.name in ranking]
+        ranking = [n for n in ranking if n not in floors] + floors
+        placement.ranking = ranking
+        # Re-stamp the audit table to the order that will EXECUTE — the
+        # searched rank/reason would otherwise contradict the replay.
+        for rec in placement.candidates:
+            rec.rank = None
+        for i, name in enumerate(ranking):
+            rec = placement.candidate(name)
+            if rec is None:
+                continue
+            rec.rank = i
+            if rec.predicted_seconds is not None:
+                rec.reason = (
+                    f"rank {i} (forced replay): predicted "
+                    f"{rec.predicted_seconds:.4g}s (prior "
+                    f"{rec.raw_seconds:.4g}s x calibration "
+                    f"{rec.calibration:.3g} from {rec.samples} outcome(s))"
+                )
+
+    by_name = {c.name: c for c in candidates}
+    measured: dict[str, float] = {}
+
+    def wrap(c: Candidate) -> kmem.Tier:
+        cached_deny = placement.analytic_plans.get(c.name)
+        # A pruned candidate's walk "plan" IS the search's analytic deny —
+        # denied for free, never compiled; the ladder records the denial
+        # at its hand position like any preflight-denied tier.
+        plan_fn = (
+            (lambda: cached_deny) if cached_deny is not None else c.plan
+        )
+
+        def run(mplan):
+            rec = placement.candidate(c.name)
+            t0 = time.perf_counter()
+            with trace.plan_span(
+                f"plan:{c.name}",
+                predicted_seconds=rec.predicted_seconds if rec else None,
+                label=label,
+                rank=rec.rank if rec else None,
+            ):
+                try:
+                    out = c.run(mplan)
+                except Exception:
+                    measured[c.name] = time.perf_counter() - t0
+                    raise
+            measured[c.name] = time.perf_counter() - t0
+            return out
+
+        return kmem.Tier(c.name, plan_fn, run)
+
+    tiers = [wrap(by_name[n]) for n in placement.ranking if n in by_name]
+    try:
+        out = kmem.run_ladder(label, tiers, report)
+    finally:
+        _finish(placement, report, measured, fingerprint, label)
+    return out
+
+
+def _finish(placement, report, measured, fp, label) -> None:
+    """Post-run bookkeeping: predicted-vs-actual on the plan, outcome rows
+    to the log, step-downs counted."""
+    placement.chosen = report.chosen
+    for name, secs in measured.items():
+        rec = placement.candidate(name)
+        if rec is None:
+            continue
+        rec.measured_seconds = secs
+        # Only a genuine RESOURCE_EXHAUSTED step-down (run_ladder's
+        # oom_retries) is a memory misprediction; a typed non-OOM failure
+        # that propagated must not masquerade as one in the audit trail
+        # or the plan log.
+        if name == report.chosen:
+            rec.outcome = "ok"
+        elif name in report.oom_retries:
+            rec.outcome = "oom"
+        else:
+            rec.outcome = "error"
+        append_outcome({
+            "fingerprint": fp,
+            "label": label,
+            "candidate": name,
+            "predicted_seconds": rec.predicted_seconds,
+            "measured_seconds": secs,
+            "outcome": rec.outcome,
+            "devices": placement.devices,
+            "ts": time.time(),
+        })
+    chosen_rec = (
+        placement.candidate(report.chosen) if report.chosen else None
+    )
+    if chosen_rec is not None:
+        placement.predicted_seconds = chosen_rec.predicted_seconds
+        placement.measured_seconds = chosen_rec.measured_seconds
+        if chosen_rec.predicted_seconds and chosen_rec.measured_seconds:
+            placement.prediction_error = (
+                chosen_rec.predicted_seconds / chosen_rec.measured_seconds
+            )
+    for name in report.oom_retries:
+        if placement.candidate(name) is not None:
+            counters.record(
+                "autoshard_stepdown",
+                f"{label}: ranked plan {name!r} died RESOURCE_EXHAUSTED at "
+                "runtime — stepping down the searched ranking "
+                f"(cost-model misprediction logged for {fp})",
+            )
+    report.placement = placement.record()

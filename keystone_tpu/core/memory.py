@@ -484,6 +484,108 @@ def plan_program(
     return _admission_event(plan)
 
 
+def plan_bytes(
+    label: str,
+    *,
+    argument_bytes: int = 0,
+    temp_bytes: int = 0,
+    output_bytes: int = 0,
+    extra_bytes: int = 0,
+    resident_bytes: int = 0,
+    mesh=None,
+    budget: int | None | object = _UNSET,
+) -> MemoryPlan:
+    """ANALYTIC-ONLY admission of a candidate program from caller-supplied
+    per-chip byte figures — no lower, no compile, no cache entry: the
+    zero-cost half of the placement search's candidate-batch preflight
+    (core.autoshard prunes enumerated candidates with this before any of
+    them is worth an AOT compile).
+
+    Deliberately a LOWER BOUND on what :func:`plan_program` would charge
+    (no alias credit is modeled, and callers pass only the transient floors
+    they can prove): a plan denied here is denied a fortiori by the
+    compiled preflight, while an admitted one still faces the full
+    admission when the ladder actually selects it — pruning can skip work,
+    never under-admit.  Same budget/credit semantics as ``plan_program``
+    (min per-chip free HBM under a ``mesh``; resident credit only against a
+    live free-bytes budget); denials are counted under
+    ``hbm_preflight_denied`` like any other admission decision."""
+    if mesh is not None and budget is _UNSET:
+        budget, _worst = min_chip_budget(mesh)
+    if budget is _UNSET:
+        budget = hbm_budget()
+    mesh_axes = dict(mesh.shape) if mesh is not None else None
+    if budget is None:
+        return _admission_event(MemoryPlan(
+            label=label,
+            admitted=True,
+            reason=(
+                "no HBM budget known (no device memory_stats and "
+                f"{HBM_BUDGET_ENV} unset) — analytic admission skipped"
+            ),
+            argument_bytes=int(argument_bytes),
+            temp_bytes=int(temp_bytes),
+            output_bytes=int(output_bytes),
+            extra_bytes=int(extra_bytes),
+            resident_bytes=int(resident_bytes),
+            total_bytes=int(
+                argument_bytes + temp_bytes + output_bytes + extra_bytes
+            ),
+            mesh_axes=mesh_axes,
+        ))
+    total = int(argument_bytes + temp_bytes + output_bytes + extra_bytes)
+    credit = int(resident_bytes) if budget_is_live() else 0
+    admitted = total - credit <= budget
+    h = fmt_bytes
+    reason = (
+        ("fits: " if admitted else "DENIED: ")
+        + ("per-chip " if mesh is not None else "")
+        + f"analytic args {h(argument_bytes)} + temp {h(temp_bytes)} + "
+        f"out {h(output_bytes)} + extra {h(extra_bytes)} = {h(total)}"
+        + (f" (- {h(credit)} already resident)" if credit else "")
+        + f" vs budget {h(budget)} (no compile)"
+    )
+    plan = MemoryPlan(
+        label=label,
+        admitted=admitted,
+        reason=reason,
+        budget_bytes=budget,
+        argument_bytes=int(argument_bytes),
+        temp_bytes=int(temp_bytes),
+        output_bytes=int(output_bytes),
+        extra_bytes=int(extra_bytes),
+        resident_bytes=int(resident_bytes),
+        total_bytes=total,
+        analyzed=False,  # no compile happened — analytic numbers only
+        mesh_axes=mesh_axes,
+    )
+    if not admitted:
+        counters.record("hbm_preflight_denied", f"{label}: {reason}")
+    return _admission_event(plan)
+
+
+def plan_batch(
+    planners: Sequence[tuple[str, Callable[[], MemoryPlan]]],
+) -> dict[str, MemoryPlan]:
+    """Candidate-batch preflight: evaluate every ``(label, planner)`` pair
+    and return ``{label: MemoryPlan}``.  A planner that RAISES becomes a
+    denied plan carrying the error (one broken candidate must not kill the
+    search over the others) — the batch analog of ``plan_program``'s
+    compile-failure-is-an-answer rule."""
+    out: dict[str, MemoryPlan] = {}
+    for label, planner in planners:
+        try:
+            out[label] = planner()
+        except Exception as e:  # noqa: BLE001 — a failed plan IS a deny
+            out[label] = _admission_event(MemoryPlan(
+                label=label,
+                admitted=False,
+                reason=f"planner failed: {type(e).__name__}: {e}"[:200],
+                error=f"{type(e).__name__}: {e}"[:300],
+            ))
+    return out
+
+
 def plan_cache_bytes(
     label: str,
     nbytes: int,
@@ -628,6 +730,11 @@ class FitReport:
     #: RAN the solve; ``None`` after a step-down to the single-device floor
     #: (and for plain single-device fits).
     mesh_shape: dict | None = None
+    #: placement search (core.autoshard): the PlacementPlan record of the
+    #: searched ranking this fit ran through — the full candidate table
+    #: with deny/score rationale and the chosen plan's predicted-vs-actual
+    #: cost.  ``None`` when the fit walked the hand ladder.
+    placement: dict | None = None
 
     def record(self) -> dict:
         """JSON-able form for bench artifacts."""
@@ -640,6 +747,7 @@ class FitReport:
             "denials": list(self.denials),
             "oom_retries": list(self.oom_retries),
             "tiers": {k: p.breakdown() for k, p in self.plans.items()},
+            "placement": self.placement,
         }
 
     def summary(self) -> str:

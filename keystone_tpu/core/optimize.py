@@ -412,6 +412,90 @@ def release_caches(pipeline: Pipeline) -> None:
             n.clear_memo()
 
 
+# -- the placement cost model (shared with core.autoshard) --------------------
+
+#: Per-chip bf16/f32 peak FLOP/s and HBM GB/s by device kind — the roofline
+#: rates the analytic placement prior divides by.  Unknown kinds (the CPU
+#: test platform included) fall back to :data:`_DEFAULT_RATES`; only the
+#: RELATIVE ranking of candidate plans matters to the search, and the
+#: learned calibration (core.autoshard's plan-outcome log) absorbs the
+#: absolute error across runs.
+DEVICE_RATES: dict[str, dict] = {
+    "TPU v4": {"peak_flops": 275e12, "hbm_gbps": 1228.0, "ici_gbps": 50.0},
+    "TPU v5e": {"peak_flops": 197e12, "hbm_gbps": 819.0, "ici_gbps": 50.0},
+    "TPU v5 lite": {"peak_flops": 197e12, "hbm_gbps": 819.0, "ici_gbps": 50.0},
+    "TPU v5p": {"peak_flops": 459e12, "hbm_gbps": 2765.0, "ici_gbps": 100.0},
+    "TPU v6e": {"peak_flops": 918e12, "hbm_gbps": 1640.0, "ici_gbps": 100.0},
+}
+
+_DEFAULT_RATES = {"peak_flops": 50e9, "hbm_gbps": 20.0, "ici_gbps": 5.0}
+
+
+@dataclasses.dataclass
+class CostModel:
+    """Analytic roofline prior for one candidate placement's solve wall.
+
+    The Learned-Cost-Model placement paper's structure (PAPERS.md): an
+    analytic prior over the quantities a plan determines — per-chip bytes
+    moved through HBM, per-chip FLOPs, host<->device dispatch round trips,
+    H2D streaming traffic, cross-chip collective volume — refined by a
+    learned per-(program, candidate) calibration factor fitted to measured
+    outcomes (core.autoshard reads them from the persistent plan-outcome
+    log and multiplies :meth:`predict_seconds` by the measured/predicted
+    ratio).  The prior only has to RANK candidates sanely on a cold start;
+    the calibration makes the absolute numbers honest across runs.
+    """
+
+    peak_flops: float = _DEFAULT_RATES["peak_flops"]
+    hbm_gbps: float = _DEFAULT_RATES["hbm_gbps"]
+    ici_gbps: float = _DEFAULT_RATES["ici_gbps"]
+    h2d_gbps: float = 8.0  #: PCIe-class host->device streaming rate
+    dispatch_seconds: float = 1e-3  #: one host->device dispatch round trip
+
+    @classmethod
+    def for_devices(cls, devices=None) -> "CostModel":
+        """Rates for the live platform (:data:`DEVICE_RATES` by
+        ``device_kind``, default rates for unknown kinds)."""
+        try:
+            if devices is None:
+                import jax
+
+                devices = jax.devices()
+            kind = devices[0].device_kind
+        except Exception:  # noqa: BLE001 — no backend: relative ranking only
+            kind = ""
+        rates = DEVICE_RATES.get(kind, _DEFAULT_RATES)
+        return cls(
+            peak_flops=rates["peak_flops"],
+            hbm_gbps=rates["hbm_gbps"],
+            ici_gbps=rates["ici_gbps"],
+        )
+
+    def predict_seconds(self, hints: dict) -> float:
+        """Prior wall seconds for one candidate from its cost hints.
+
+        ``hints`` keys (all optional, per chip): ``arg_bytes`` /
+        ``out_bytes`` / ``temp_bytes`` (HBM traffic, charged once),
+        ``hbm_passes`` (how many times the solve streams that working set;
+        default 1), ``flops``, ``dispatches``, ``h2d_bytes``,
+        ``coll_bytes``.  The roofline term takes the MAX of the HBM and
+        FLOP times (they overlap on the MXU); dispatches, H2D streaming,
+        and collectives are serial adders."""
+        touched = (
+            hints.get("arg_bytes", 0)
+            + hints.get("out_bytes", 0)
+            + hints.get("temp_bytes", 0)
+        ) * max(1.0, float(hints.get("hbm_passes", 1)))
+        hbm_s = touched / (self.hbm_gbps * 2**30)
+        flop_s = float(hints.get("flops", 0.0)) / self.peak_flops
+        return (
+            max(hbm_s, flop_s)
+            + float(hints.get("dispatches", 1)) * self.dispatch_seconds
+            + float(hints.get("h2d_bytes", 0)) / (self.h2d_gbps * 2**30)
+            + float(hints.get("coll_bytes", 0)) / (self.ici_gbps * 2**30)
+        )
+
+
 # -- the snapshot advisor -----------------------------------------------------
 
 #: env var: assumed snapshot-disk sequential bandwidth (GB/s) used by the

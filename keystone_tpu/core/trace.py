@@ -259,6 +259,44 @@ def io_span(name: str, nbytes: int, cat: str = "io", **attrs):
     return _IOSpan(name, cat, attrs)
 
 
+class _PlanSpan(Span):
+    """A span over work the placement search PREDICTED a cost for
+    (core.autoshard): records ``predicted_s`` (and optionally
+    ``predicted_bytes``) up front and derives ``measured_s`` plus the
+    predicted/measured ratio ``prediction_error`` at exit — the trace
+    answers "how wrong was the cost model on the plan it chose?" without
+    cross-referencing the plan log by hand."""
+
+    __slots__ = ()
+
+    def __exit__(self, etype, exc, tb):
+        measured = (_now_us() - self.t0) / 1e6
+        self.attrs["measured_s"] = round(measured, 6)
+        predicted = self.attrs.get("predicted_s")
+        if predicted and measured > 0:
+            self.attrs["prediction_error"] = round(predicted / measured, 4)
+        return super().__exit__(etype, exc, tb)
+
+
+def plan_span(
+    name: str,
+    predicted_seconds: float | None = None,
+    predicted_bytes: int | None = None,
+    cat: str = "plan",
+    **attrs,
+):
+    """Span for a placement-plan choice: like :func:`span`, plus
+    predicted-vs-measured cost accounting (``predicted_s`` /
+    ``measured_s`` / ``prediction_error`` attrs)."""
+    if not _enabled:
+        return _NULL
+    if predicted_seconds is not None:
+        attrs["predicted_s"] = round(float(predicted_seconds), 6)
+    if predicted_bytes is not None:
+        attrs["predicted_bytes"] = int(predicted_bytes)
+    return _PlanSpan(name, cat, attrs)
+
+
 def instant(name: str, **attrs) -> None:
     """Point event (admission decision, fault count) on the current
     thread's timeline.

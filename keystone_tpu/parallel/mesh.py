@@ -55,6 +55,35 @@ def mesh_desc(mesh: Mesh) -> str:
     return f"{mesh.shape[DATA_AXIS]}x{mesh.shape[MODEL_AXIS]}"
 
 
+def enumerate_mesh_shapes(n_devices: int) -> list[tuple[int, int]]:
+    """Every (data, model) factorization of ``n_devices``, data-major
+    descending — the candidate set the placement search (core.autoshard)
+    scores instead of the hand ladder's two fixed rungs.  All devices
+    participate in every candidate (a smaller mesh never beats a larger one
+    on the cost model's axes, and the single-device strategies are their
+    own candidates); ``n_devices=1`` is the one-shape list ``[(1, 1)]``,
+    and a prime count yields exactly its two degenerate factorizations."""
+    if n_devices < 1:
+        raise ValueError(f"need >= 1 device, got {n_devices}")
+    return [
+        (d, n_devices // d)
+        for d in range(n_devices, 0, -1)
+        if n_devices % d == 0
+    ]
+
+
+def enumerate_meshes(devices) -> list[Mesh]:
+    """:func:`enumerate_mesh_shapes` materialized over a fixed device
+    list — the same devices in the same order for every candidate, so two
+    searches over one device set enumerate identical meshes (searched-plan
+    determinism)."""
+    devices = list(devices)
+    return [
+        make_mesh(data=d, model=m, devices=devices)
+        for d, m in enumerate_mesh_shapes(len(devices))
+    ]
+
+
 _current_mesh: list[Mesh] = []
 
 

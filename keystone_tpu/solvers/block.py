@@ -21,6 +21,7 @@ import jax.scipy.linalg as jsl
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import autoshard
 from ..core import memory as kmem
 from ..core import trace
 from ..core.checkpoint import CheckpointError, _atomic_write_bytes
@@ -31,6 +32,7 @@ from ..parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     current_mesh,
+    enumerate_meshes,
     mesh_desc,
     pad_shard_inputs,
     reduced_mesh,
@@ -716,6 +718,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         checkpoint=None,
         resume_from=None,
         donate: bool | None = None,
+        plan=None,
     ) -> BlockLinearMapper:
         """``nvalid``: true global row count when inputs were zero-padded for
         sharding — pad rows are masked back to zero after centering so grams
@@ -753,6 +756,19 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         (the caller must not reuse them; an exec-level OOM then cannot
         rebuild them for the step-down), ``False`` never donates.  The
         decision trail is ``self.last_fit_report``.
+
+        Placement search (core.autoshard, on by default): the ladders above
+        are the HAND enumeration — the fit actually runs the cost-model
+        RANKED candidate list (every (data, model) mesh factorization of
+        the live devices x fused/stepwise/host-staged strategy), pruned by
+        the zero-cost batch preflight, with the hand order as the
+        untrained-model tie-break and the host-staged/single-device floor
+        pinned last; runtime RESOURCE_EXHAUSTED steps down the ranked list
+        (counted ``autoshard_stepdown``) exactly as the hand ladder did.
+        ``plan``: ``None`` honors ``KEYSTONE_AUTOSHARD``, ``False`` forces
+        the hand ladder, ``True`` forces the search, a ``PlacementPlan``
+        (or candidate-name list) replays a previous ranking.  The searched
+        table lands in ``last_fit_report.placement``.
         """
         mesh = self.mesh if self.mesh is not None else current_mesh()
         resumable = checkpoint is not None or resume_from is not None
@@ -799,15 +815,18 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         elif mesh is not None:
             # Multi-chip path: the MESH degradation ladder — full
             # (data, model) mesh with per-chip admission, then the
-            # model-axis-collapsed mesh, then the single-device ladder.
+            # model-axis-collapsed mesh, then the single-device ladder —
+            # searched/ranked by core.autoshard unless plan=False.
             models, label_mean, means = self._fit_mesh_ladder(
-                features, x, labels, num_features, nvalid, widths, mesh
+                features, x, labels, num_features, nvalid, widths, mesh,
+                plan_arg=plan,
             )
         else:
             if nvalid is None:
                 nvalid = int(jnp.shape(labels)[0])
             models, label_mean, means = self._fit_ladder(
-                features, x, labels, num_features, nvalid, widths, donate
+                features, x, labels, num_features, nvalid, widths, donate,
+                plan_arg=plan,
             )
         model_list = [models[i, :w] for i, w in enumerate(widths)]
         feature_scalers = [
@@ -818,7 +837,8 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         )
 
     def _fit_mesh_ladder(
-        self, features, x, labels, num_features, nvalid, widths, mesh
+        self, features, x, labels, num_features, nvalid, widths, mesh,
+        plan_arg=None,
     ):
         """Distributed solve through the MESH degradation ladder.
 
@@ -846,11 +866,50 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         report = kmem.FitReport(label="bcd_fit")
         self.last_fit_report = report
 
-        def mesh_tier(m):
+        itx = np.dtype(xdt).itemsize
+
+        def mesh_tier(m, prior_rank, hand):
             name = f"fused[mesh {mesh_desc(m)}]"
             d_sz, m_sz = m.shape[DATA_AXIS], m.shape[MODEL_AXIS]
             n_pad = n0 + (-n0) % d_sz
             k_pad = k + (-k) % m_sz
+            # Analytic per-chip transient floor (CPU backends report
+            # temp 0): one centered row-sharded block, the replicated
+            # Cholesky stack, two residual carries, the model-axis-
+            # sharded models carry.  Also the cost model's temp term and
+            # the zero-cost prune's byte figure — one formula, three uses.
+            floor = it * (
+                n_pad * bs // d_sz
+                + nb * bs * bs
+                + 2 * n_pad * k_pad // d_sz
+                + nb * bs * k_pad // m_sz
+            )
+            hints = {
+                # Per-operand bytes from the program's AVALS through the
+                # spec enumeration (data/model/replicated over divisible
+                # dims, minimum per-chip bytes) — the best sharding this
+                # mesh shape can achieve, a lower bound of any layout the
+                # compiled admission will charge.
+                "arg_bytes": sum(
+                    autoshard.best_spec(a, dict(m.shape))["per_chip_bytes"]
+                    for a in (
+                        jax.ShapeDtypeStruct((n_pad, nb * bs), xdt),
+                        jax.ShapeDtypeStruct((n_pad, k_pad), dtype),
+                    )
+                ),
+                "temp_bytes": floor,
+                "out_bytes": it * (nb * bs * k_pad // m_sz + k_pad + nb * bs),
+                "flops": (
+                    2.0 * n_pad * bs * bs * nb
+                    + self.num_iter * 4.0 * n_pad * bs * k_pad * nb
+                ) / (d_sz * m_sz),
+                "dispatches": 1,
+                "hbm_passes": self.num_iter + 1,
+                "coll_bytes": (
+                    it * nb * (bs * bs + self.num_iter * bs * k_pad)
+                    if d_sz > 1 else 0
+                ),
+            }
 
             def plan():
                 budget, _worst = kmem.min_chip_budget(m)
@@ -859,16 +918,6 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 x_s = sds((n_pad, nb * bs), xdt, sharding=row)
                 y_s = sds((n_pad, k_pad), dtype, sharding=row)
                 lam_s, i32_s = sds((), dtype), sds((), jnp.int32)
-                # Analytic per-chip transient floor (CPU backends report
-                # temp 0): one centered row-sharded block, the replicated
-                # Cholesky stack, two residual carries, the model-axis-
-                # sharded models carry.
-                floor = it * (
-                    n_pad * bs // d_sz
-                    + nb * bs * bs
-                    + 2 * n_pad * k_pad // d_sz
-                    + nb * bs * k_pad // m_sz
-                )
                 return kmem.plan_program(
                     _fused_bcd_fit, x_s, y_s, lam_s, i32_s,
                     self.num_iter, widths, m,
@@ -894,7 +943,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                     label_mean = label_mean[:k]
                 return models, label_mean, means
 
-            return kmem.Tier(name, plan, run)
+            return autoshard.Candidate(
+                name, "fused_mesh", plan, run, hints=hints,
+                mesh_axes=dict(m.shape), prior_rank=prior_rank, hand=hand,
+            )
 
         def plan_single():
             return kmem.MemoryPlan(
@@ -920,17 +972,53 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             )
             out = self._fit_ladder(
                 x_h, x_h, y_h, num_features, nvalid0, widths, None,
+                # The mesh-level search already ranked this floor; the
+                # nested single-device ladder walks its hand order (a
+                # nested search would overwrite the report's placement).
+                plan_arg=False,
                 report=report,
             )
             inner_chosen.append(report.chosen)
             return out
 
-        tiers = [mesh_tier(mesh)]
+        cands = [mesh_tier(mesh, 0, True)]
         rm = reduced_mesh(mesh)
         if rm is not None:
-            tiers.append(mesh_tier(rm))
-        tiers.append(kmem.Tier("single_device", plan_single, run_single))
-        out = kmem.run_ladder("bcd_fit", tiers, report)
+            cands.append(mesh_tier(rm, 1, True))
+        # The searched candidate set: every remaining (data, model)
+        # factorization of the SAME devices, ranked by the cost model but
+        # never promoted past the hand rungs on an untrained prior.  Only
+        # enumerated when the search will run — a hand-ladder walk would
+        # discard them, and each costs a jax Mesh construction.
+        if autoshard.will_search(plan_arg):
+            hand_shapes = {
+                mesh_desc(c_mesh) for c_mesh in (mesh, rm) if c_mesh
+            }
+            for extra in enumerate_meshes(list(mesh.devices.flat)):
+                if mesh_desc(extra) not in hand_shapes:
+                    cands.append(mesh_tier(extra, len(cands), False))
+        cands.append(autoshard.Candidate(
+            "single_device", "single_device", plan_single, run_single,
+            hints={
+                # Host pull + refit on one chip: the whole design matrix
+                # crosses back over PCIe and nothing divides — the floor's
+                # predicted cost is honest about why it is the floor.
+                "arg_bytes": itx * n0 * nb * bs + it * n0 * k,
+                "h2d_bytes": itx * n0 * nb * bs + it * n0 * k,
+                "flops": 2.0 * n0 * bs * bs * nb
+                + self.num_iter * 4.0 * n0 * bs * k * nb,
+                "dispatches": 3,
+            },
+            prior_rank=len(cands), floor=True,
+        ))
+        out = autoshard.run_search(
+            "bcd_fit", cands, report,
+            fingerprint=autoshard.fingerprint(
+                "bcd_fit", n0, k, widths, self.num_iter, str(xdt),
+                str(dtype), dict(mesh.shape), autoshard.device_fingerprint(),
+            ),
+            plan=plan_arg,
+        )
         if inner_chosen and report.chosen == "single_device":
             # Keep the inner rung visible: "single_device/host_staged".
             report.chosen = f"single_device/{inner_chosen[0]}"
@@ -938,7 +1026,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
     def _fit_ladder(
         self, features, x, labels, num_features, nvalid, widths, donate,
-        report=None,
+        plan_arg=None, report=None,
     ):
         """Single-device solve through the degradation ladder.
 
@@ -1069,12 +1157,70 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         if report is None:
             report = kmem.FitReport(label="bcd_fit", budget_bytes=budget)
             self.last_fit_report = report
-        return kmem.run_ladder(
-            "bcd_fit",
-            [
-                kmem.Tier("fused", plan_fused, run_fused),
-                kmem.Tier("stepwise", plan_stepwise, run_stepwise),
-                kmem.Tier("host_staged", plan_host, run_host),
-            ],
-            report,
+        itx = np.dtype(xdt).itemsize
+        x_bytes, y_bytes = itx * n * nb * bs, it * n * k
+        flops = (
+            2.0 * n * bs * bs * nb + self.num_iter * 4.0 * n * bs * k * nb
+        )
+        per_block_dispatches = nb * (self.num_iter + 1) + 2
+        cands = [
+            autoshard.Candidate(
+                "fused", "fused", plan_fused, run_fused,
+                hints={
+                    "arg_bytes": x_bytes + y_bytes,
+                    # The donating variant aliases its donated args — the
+                    # zero-cost prune must stay a lower bound of the
+                    # compiled admission, which credits them back.
+                    "alias_bytes": (
+                        (x_bytes if 0 in dn else 0)
+                        + (y_bytes if 1 in dn else 0)
+                    ),
+                    "temp_bytes": fused_floor,
+                    "out_bytes": it * (nb * bs * k + k + nb * bs),
+                    "resident_bytes": res_dev,
+                    "flops": flops,
+                    "dispatches": 1,
+                    "hbm_passes": self.num_iter + 1,
+                },
+                prior_rank=0,
+            ),
+            autoshard.Candidate(
+                "stepwise", "stepwise", plan_stepwise, run_stepwise,
+                hints={
+                    "arg_bytes": x_bytes + y_bytes,
+                    "temp_bytes": it * (n * bs + n * k),
+                    "out_bytes": it * nb * bs * k,
+                    "extra_bytes": persist,
+                    "resident_bytes": res_dev,
+                    "flops": flops,
+                    "dispatches": per_block_dispatches,
+                    "hbm_passes": self.num_iter + 1,
+                },
+                prior_rank=1,
+            ),
+            autoshard.Candidate(
+                "host_staged", "host_staged", plan_host, run_host,
+                hints={
+                    "arg_bytes": itx * n * bs + y_bytes,
+                    "temp_bytes": it * n * k,
+                    "extra_bytes": persist + it * nb * bs,
+                    "resident_bytes": res_dev,
+                    "flops": flops,
+                    "dispatches": per_block_dispatches,
+                    # Each epoch re-streams every block over PCIe — the
+                    # term that keeps the floor at the bottom of every
+                    # untrained ranking.
+                    "h2d_bytes": self.num_iter * x_bytes,
+                },
+                prior_rank=2, floor=True,
+            ),
+        ]
+        return autoshard.run_search(
+            "bcd_fit", cands, report,
+            fingerprint=autoshard.fingerprint(
+                "bcd_fit", n, k, widths, self.num_iter, str(xdt),
+                str(dtype), None, autoshard.device_fingerprint(),
+            ),
+            plan=plan_arg,
+            budget=budget,
         )

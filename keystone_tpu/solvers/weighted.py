@@ -45,6 +45,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import dataclasses
 
+from ..core import autoshard
 from ..core import memory as kmem
 from ..core import trace
 from ..core.pipeline import LabelEstimator
@@ -53,6 +54,7 @@ from ..parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     current_mesh,
+    enumerate_meshes,
     mesh_desc,
     reduced_mesh,
 )
@@ -558,6 +560,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         num_features: int | None = None,
         nvalid: int | None = None,
         donate: bool | None = None,
+        plan=None,
     ) -> BlockLinearMapper:
         """``features``/``labels`` may be host arrays OR device-resident
         (row-sharded) ``jax.Array``s — the full design matrix is never
@@ -579,7 +582,17 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         device-resident inputs as soon as their sorted copies exist —
         halving the peak across the class-sort gather — at the price that
         an exec-level OOM can no longer rebuild them for the step-down.
-        The decision trail is ``self.last_fit_report``."""
+        The decision trail is ``self.last_fit_report``.
+
+        Placement search (core.autoshard, on by default): the ladders are
+        the HAND enumeration — the fit runs the cost-model RANKED candidate
+        list (every (data, model) mesh factorization x strategy), pruned by
+        the zero-cost batch preflight, hand order as the untrained
+        tie-break, floor pinned last, runtime OOM stepping down the ranked
+        list (counted ``autoshard_stepdown``).  ``plan``: ``None`` honors
+        ``KEYSTONE_AUTOSHARD``, ``False`` hand ladder, ``True`` forces the
+        search, a ``PlacementPlan``/name list replays a ranking; the table
+        lands in ``last_fit_report.placement``."""
         mesh = self.mesh if self.mesh is not None else current_mesh()
         n = nvalid if nvalid is not None else int(np.shape(labels)[0])
         n_classes = int(np.shape(labels)[1])
@@ -768,22 +781,23 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         if mesh is not None:
             # Multi-chip path: the mesh degradation ladder — full
             # (data, model) mesh with per-chip admission, then the
-            # model-axis-collapsed mesh, then the single-device ladder.
+            # model-axis-collapsed mesh, then the single-device ladder —
+            # searched/ranked by core.autoshard unless plan=False.
             models_st, b = self._fit_mesh_ladder(
                 features, x, labels, prep, mesh, order, n, n_max,
-                n_classes, widths, dtype, donate,
+                n_classes, widths, dtype, donate, plan_arg=plan,
             )
         else:
             models_st, b = self._fit_ladder(
                 features, x, labels, prep(None, labels), order, n, n_max,
-                n_classes, widths, dtype, donate,
+                n_classes, widths, dtype, donate, plan_arg=plan,
             )
         model_list = [models_st[i, :wd] for i, wd in enumerate(widths)]
         return BlockLinearMapper(model_list, self.block_size, b)
 
     def _fit_mesh_ladder(
         self, features, x, labels, prep, mesh, order, n, n_max, n_classes,
-        widths, dtype, donate,
+        widths, dtype, donate, plan_arg=None,
     ):
         """Distributed BWLS through the MESH degradation ladder: full
         ``(data, model)`` mesh → model-axis-collapsed mesh (row-sharded
@@ -800,9 +814,59 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         report = kmem.FitReport(label="bwls_fit")
         self.last_fit_report = report
 
-        def mesh_tier(m):
+        itx = np.dtype(xdt).itemsize
+
+        def mesh_tier(m, prior_rank, hand):
             name = f"fused[mesh {mesh_desc(m)}]"
             d_sz, m_sz = m.shape[DATA_AXIS], m.shape[MODEL_AXIS]
+            # The tier's padded layout, computed WITHOUT building the ctx
+            # (the search scores every enumerated mesh shape; the O(p_tot)
+            # gather/seg/mask buffers stay lazy below).
+            p_tot_a = n + n_max + ((-(n + n_max)) % d_sz)
+            chunk_a = max(1, min(self.class_chunk, n_classes))
+            chunk_a = -(-chunk_a // m_sz) * m_sz
+            # Analytic per-chip transient floor (CPU backends report
+            # temp 0): two row-sharded residual carries, one row-sharded
+            # block slice, the model-axis-sharded class-solve slab, the
+            # replicated stats/models stacks.  Also the cost model's temp
+            # term and the zero-cost prune's figure — one formula.
+            floor = it * (
+                2 * p_tot_a * n_classes // d_sz
+                + p_tot_a * bs // d_sz
+                + chunk_a * n_max * bs // m_sz
+                + nb * (bs * bs + bs + n_classes * bs)
+                + nb * bs * n_classes
+            )
+            hints = {
+                # Per-operand bytes from the program's AVALS through the
+                # spec enumeration (minimum per-chip bytes over the legal
+                # data/model/replicated shardings of each dim) — a lower
+                # bound of any layout the compiled admission will charge;
+                # the valid/seg vectors the program truly replicates are
+                # charged replicated.
+                "arg_bytes": sum(
+                    autoshard.best_spec(a, dict(m.shape))["per_chip_bytes"]
+                    for a in (
+                        jax.ShapeDtypeStruct((p_tot_a, d_tot), xdt),
+                        jax.ShapeDtypeStruct((p_tot_a, n_classes), dtype),
+                    )
+                ) + it * p_tot_a,  # replicated valid/seg vectors
+                "temp_bytes": floor,
+                "out_bytes": it * (nb * bs * n_classes + n_classes),
+                "flops": (
+                    self.num_iter * nb * (
+                        2.0 * p_tot_a * bs * (bs + 2 * n_classes)
+                        + n_classes * n_max * bs * (bs + 2)
+                    )
+                ) / (d_sz * m_sz),
+                "dispatches": 1,
+                "hbm_passes": self.num_iter + 1,
+                "coll_bytes": (
+                    it * self.num_iter * nb
+                    * (bs * bs + bs * n_classes)
+                    if d_sz > 1 else 0
+                ),
+            }
             # Lazy, memoized: a tier's O(p_tot) gather/seg/mask buffers are
             # only built once the ladder actually CONSIDERS the tier (the
             # common admitted-first-tier fit never pays for the rungs
@@ -827,17 +891,6 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 seg_s = sds((ctx_.p_tot,), i32)
                 c_i32, c_f = sds((n_classes,), i32), sds((n_classes,), dtype)
                 sc_s, nv_s = sds((), dtype), sds((), i32)
-                # Analytic per-chip transient floor (CPU backends report
-                # temp 0): two row-sharded residual carries, one
-                # row-sharded block slice, the model-axis-sharded
-                # class-solve slab, the replicated stats/models stacks.
-                floor = it * (
-                    2 * ctx_.p_tot * n_classes // d_sz
-                    + ctx_.p_tot * bs // d_sz
-                    + ctx_.chunk * n_max * bs // m_sz
-                    + nb * (bs * bs + bs + n_classes * bs)
-                    + nb * bs * n_classes
-                )
                 return kmem.plan_program(
                     _fused_bwls_fit_variant((0, 1)),
                     x_s, y_s, v_s, seg_s, c_i32, c_i32, c_f, c_f, nv_s,
@@ -865,7 +918,10 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 # block._execute_fused_bcd_mesh); same injection point.
                 return _execute_fused_bwls(None, args, statics)
 
-            return kmem.Tier(name, plan, run)
+            return autoshard.Candidate(
+                name, "fused_mesh", plan, run, hints=hints,
+                mesh_axes=dict(m.shape), prior_rank=prior_rank, hand=hand,
+            )
 
         def plan_single():
             return kmem.MemoryPlan(
@@ -891,24 +947,61 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             )
             out = self._fit_ladder(
                 x_h, x_h, y_h, prep(None, y_h), order, n, n_max,
-                n_classes, widths, dtype, None, report=report,
+                n_classes, widths, dtype, None,
+                # The mesh-level search already ranked this floor; the
+                # nested single-device ladder walks its hand order.
+                plan_arg=False,
+                report=report,
             )
             inner_chosen.append(report.chosen)
             return out
 
-        tiers = [mesh_tier(mesh)]
+        cands = [mesh_tier(mesh, 0, True)]
         rm = reduced_mesh(mesh)
         if rm is not None:
-            tiers.append(mesh_tier(rm))
-        tiers.append(kmem.Tier("single_device", plan_single, run_single))
-        out = kmem.run_ladder("bwls_fit", tiers, report)
+            cands.append(mesh_tier(rm, 1, True))
+        # Searched candidate set: the remaining (data, model)
+        # factorizations of the same devices, ranked after the hand rungs
+        # on an untrained prior.  Only enumerated when the search will
+        # run — a hand-ladder walk would discard them, and each costs a
+        # jax Mesh construction.
+        if autoshard.will_search(plan_arg):
+            hand_shapes = {
+                mesh_desc(c_mesh) for c_mesh in (mesh, rm) if c_mesh
+            }
+            for extra in enumerate_meshes(list(mesh.devices.flat)):
+                if mesh_desc(extra) not in hand_shapes:
+                    cands.append(mesh_tier(extra, len(cands), False))
+        p_tot_s = n + n_max
+        cands.append(autoshard.Candidate(
+            "single_device", "single_device", plan_single, run_single,
+            hints={
+                "arg_bytes": itx * p_tot_s * d_tot + it * p_tot_s * n_classes,
+                "h2d_bytes": itx * p_tot_s * d_tot + it * p_tot_s * n_classes,
+                "flops": self.num_iter * nb * (
+                    2.0 * p_tot_s * bs * (bs + 2 * n_classes)
+                    + n_classes * n_max * bs * (bs + 2)
+                ),
+                "dispatches": 3,
+            },
+            prior_rank=len(cands), floor=True,
+        ))
+        out = autoshard.run_search(
+            "bwls_fit", cands, report,
+            fingerprint=autoshard.fingerprint(
+                "bwls_fit", n, n_classes, n_max, widths, self.num_iter,
+                self.class_chunk, str(xdt), str(dtype), dict(mesh.shape),
+                autoshard.device_fingerprint(),
+            ),
+            plan=plan_arg,
+        )
         if inner_chosen and report.chosen == "single_device":
             report.chosen = f"single_device/{inner_chosen[0]}"
         return out
 
     def _fit_ladder(
         self, features, x, labels, ctx, order, n, n_max, n_classes, widths,
-        dtype, donate, report=None,
+        dtype, donate, plan_arg=None, report=None,
     ):
         """Single-device BWLS through the degradation ladder (preflight
         admission per tier; runtime RESOURCE_EXHAUSTED steps down one tier).
@@ -1094,12 +1187,77 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         if report is None:
             report = kmem.FitReport(label="bwls_fit", budget_bytes=budget)
             self.last_fit_report = report
-        return kmem.run_ladder(
-            "bwls_fit",
-            [
-                kmem.Tier("fused", plan_fused, run_fused),
-                kmem.Tier("stepwise", plan_stepwise, run_stepwise),
-                kmem.Tier("host_staged", plan_host, run_host),
-            ],
-            report,
+        itx = np.dtype(xdt).itemsize
+        sorted_x_bytes = itx * p_tot * d_tot
+        sorted_y_bytes = it * p_tot * n_classes
+        flops = self.num_iter * nb * (
+            2.0 * p_tot * bs * (bs + 2 * n_classes)
+            + n_classes * n_max * bs * (bs + 2)
+        )
+        per_block_dispatches = nb * (3 * self.num_iter + 1) + 2
+        cands = [
+            autoshard.Candidate(
+                "fused", "fused", plan_fused, run_fused,
+                hints={
+                    "arg_bytes": (
+                        sorted_x_bytes + sorted_y_bytes + it * p_tot
+                    ),
+                    # The fused program always donates the fit-private
+                    # sorted copies — credited out of the prune's lower
+                    # bound exactly as the compiled admission's alias is.
+                    "alias_bytes": sorted_x_bytes + sorted_y_bytes,
+                    "temp_bytes": fused_floor,
+                    "out_bytes": it * (nb * bs * n_classes + n_classes),
+                    "extra_bytes": src_bytes,
+                    "resident_bytes": src_bytes,
+                    "flops": flops,
+                    "dispatches": 1,
+                    "hbm_passes": self.num_iter + 1,
+                },
+                prior_rank=0,
+            ),
+            autoshard.Candidate(
+                "stepwise", "stepwise", plan_stepwise, run_stepwise,
+                hints={
+                    "arg_bytes": itx * p_tot * bs + sorted_y_bytes,
+                    "temp_bytes": slab_floor,
+                    "out_bytes": it * bs * n_classes,
+                    "extra_bytes": (
+                        sorted_x_bytes + labels_bytes + stats_bytes
+                        + models_bytes + src_bytes
+                    ),
+                    "resident_bytes": src_bytes,
+                    "flops": flops,
+                    "dispatches": per_block_dispatches,
+                    "hbm_passes": self.num_iter + 1,
+                },
+                prior_rank=1,
+            ),
+            autoshard.Candidate(
+                "host_staged", "host_staged", plan_host, run_host,
+                hints={
+                    "arg_bytes": itx * p_tot * bs + sorted_y_bytes,
+                    "temp_bytes": slab_floor,
+                    "out_bytes": it * bs * n_classes,
+                    "extra_bytes": (
+                        labels_bytes + stats_bytes + models_bytes + src_bytes
+                    ),
+                    "resident_bytes": src_bytes,
+                    "flops": flops,
+                    "dispatches": per_block_dispatches,
+                    # Every pass re-streams each sorted block over PCIe.
+                    "h2d_bytes": (self.num_iter + 1) * sorted_x_bytes,
+                },
+                prior_rank=2, floor=True,
+            ),
+        ]
+        return autoshard.run_search(
+            "bwls_fit", cands, report,
+            fingerprint=autoshard.fingerprint(
+                "bwls_fit", n, n_classes, n_max, widths, self.num_iter,
+                self.class_chunk, str(xdt), str(dtype), None,
+                autoshard.device_fingerprint(),
+            ),
+            plan=plan_arg,
+            budget=budget,
         )

@@ -97,6 +97,11 @@ class RandomCifarConfig:
     #: the hand-placed always-materialize.  Decision table in
     #: ``results["cache_plan"]``.
     auto_cache: bool = False
+    #: Placement search (core.autoshard): force the cost-model-ranked
+    #: candidate search for the block solve (on by default via
+    #: ``KEYSTONE_AUTOSHARD``); the searched table lands in
+    #: ``results["placement"]`` whenever a search ran.
+    auto_shard: bool = False
     #: Closed-loop ingest autotuner on the ``--streamTestTar`` path: retune
     #: decode width / ring depth / decode-ahead mid-stream from live stall
     #: metrics (results carry the knob trajectory).
@@ -250,6 +255,42 @@ def cifar_tar_loader(path: str) -> LabeledImageBatch:
     )
 
 
+def cifar_tar_stream_loader(
+    path: str, *, batch: int = 256, config=None
+) -> LabeledImageBatch:
+    """Streamed counterpart of :func:`cifar_tar_loader` (ROADMAP
+    carry-over: the streamed TRAIN path): the resident subset filter
+    learning needs is decoded through ``core.ingest`` — overlapped decode
+    pool, corrupt members skipped-and-counted, and with
+    ``config.snapshot_dir`` set the decoded chunks tee into the
+    materialized snapshot cache so repeat fits stream the images at IO
+    speed — instead of the eager threaded decode.  Batches scatter back to
+    stream-ordinal (tar member) order, so the result is BIT-IDENTICAL to
+    the eager loader on a clean tar: same images array, same labels, same
+    order (the tests pin it)."""
+    parts: list = []
+    name_pairs: list = []
+    n = 0
+    with stream_batches(path, batch, config=config, transfer=False) as st:
+        for b in st:
+            parts.append((np.asarray(b.indices), np.asarray(b.host)))
+            name_pairs.extend(zip(b.indices.tolist(), b.names))
+            n += len(b)
+    if not parts:
+        return LabeledImageBatch(
+            np.zeros((0, 1, 1, 3), np.float32), np.zeros(0, np.int32)
+        )
+    shape = parts[0][1].shape[1:]
+    images = np.zeros((n,) + shape, np.float32)
+    for idx, imgs in parts:
+        images[idx] = imgs
+    names = [None] * n
+    for i, name in name_pairs:
+        names[i] = name
+    labels = np.asarray([cifar_tar_label(nm) for nm in names], np.int32)
+    return LabeledImageBatch(images, labels)
+
+
 def _pad_to_chunk(batch, chunk: int):
     """One streamed batch padded up to the compiled ``chunk`` rows (the
     jitted featurizer has exactly one shape) — THE single implementation
@@ -393,6 +434,7 @@ def run(
             labels,
             checkpoint=conf.solve_checkpoint,
             resume_from=conf.solve_resume,
+            plan=True if conf.auto_shard else None,
         )
         log_fit_report(solver, label="cifar random-patch solve")
         if numerics_guard_enabled():
@@ -490,6 +532,11 @@ def run(
     }
     if cache_plan is not None:
         results["cache_plan"] = cache_plan.record()
+    rep = solver.last_fit_report
+    if rep is not None and rep.placement is not None:
+        # The searched placement table — candidates, deny/score rationale,
+        # chosen plan with predicted-vs-actual cost.
+        results["placement"] = rep.placement
     if conf.stream_test_tar is not None and results_autotune is not None:
         results["autotune"] = results_autotune
     # The fitted SERVABLE chain, checkpointed whole for the endpoint:
@@ -578,7 +625,12 @@ def _maybe_serve(conf: RandomCifarConfig, test, results: dict, log) -> None:
 
 def main(argv=None):
     p = argparse.ArgumentParser("RandomPatchCifar")
-    p.add_argument("--trainLocation", required=True)
+    p.add_argument(
+        "--trainLocation",
+        default=None,
+        help="CIFAR binary (or JPEG tar); optional when --streamTrainTar "
+        "supplies the train split",
+    )
     p.add_argument(
         "--testLocation",
         default=None,
@@ -599,6 +651,15 @@ def main(argv=None):
         default=None,
         help="streaming ingest: score test from this JPEG tar "
         "('<label>/name.jpg' members) with decode/featurize overlap",
+    )
+    p.add_argument(
+        "--streamTrainTar",
+        default=None,
+        help="streaming ingest for the TRAIN split: decode this JPEG tar "
+        "('<label>/name.jpg' members) through core.ingest into the "
+        "resident images filter learning needs — overlapped decode, "
+        "snapshot-cache warm repeats via --snapshotDir, bit-identical to "
+        "the eager loader (replaces --trainLocation)",
     )
     p.add_argument(
         "--decodeBackend",
@@ -630,6 +691,14 @@ def main(argv=None):
         "featurizer on a sample and cache its output only where "
         "recompute x reuse beats the HBM cost (KEYSTONE_AUTOCACHE=1 "
         "equivalent)",
+    )
+    p.add_argument(
+        "--autoShard",
+        action="store_true",
+        help="placement search (core.autoshard): force the cost-model "
+        "ranked mesh/strategy candidate search for the block solve and "
+        "record the searched plan in results['placement'] (on by "
+        "default; KEYSTONE_AUTOSHARD=0 disables it except here)",
     )
     p.add_argument(
         "--autoTune",
@@ -666,8 +735,10 @@ def main(argv=None):
     # Before the load stage timer, so its log line has a handler to land on
     # (run() re-applies the same idempotent configuration).
     configure_logging()
+    if a.trainLocation is None and a.streamTrainTar is None:
+        p.error("one of --trainLocation / --streamTrainTar is required")
     conf = RandomCifarConfig(
-        train_location=a.trainLocation,
+        train_location=a.trainLocation or a.streamTrainTar,
         test_location=a.testLocation,
         num_filters=a.numFilters,
         patch_size=a.patchSize,
@@ -680,6 +751,7 @@ def main(argv=None):
         whitener_size=a.whitenerSize,
         stream_test_tar=a.streamTestTar,
         auto_cache=a.autoCache or optimize.auto_cache_env(),
+        auto_shard=a.autoShard,
         auto_tune=a.autoTune,
         decode_backend=a.decodeBackend,
         snapshot_dir=a.snapshotDir,
@@ -700,7 +772,21 @@ def main(argv=None):
         return cifar_loader(location)
 
     with stage_timer("load"):
-        train = load_split(conf.train_location)
+        if a.streamTrainTar is not None:
+            # Streamed TRAIN path: the resident subset filter learning
+            # needs arrives through core.ingest (+ the snapshot cache when
+            # --snapshotDir is set) instead of eager threaded decode —
+            # bit-identical images/labels, warm repeats at IO speed.
+            train = cifar_tar_stream_loader(
+                a.streamTrainTar,
+                batch=conf.featurize_chunk,
+                config=stream_config_from_flags(
+                    decode_backend=conf.decode_backend,
+                    snapshot_dir=conf.snapshot_dir,
+                ),
+            )
+        else:
+            train = load_split(conf.train_location)
         if a.streamTestTar is not None:
             # streamed test split: run() never touches the eager test
             # batch — loading --testLocation too would decode a tar just

@@ -64,6 +64,12 @@ class MnistRandomFFTConfig:
     #: ``KEYSTONE_HBM_BUDGET`` the optimizer picks recompute instead of
     #: OOMing on residency.  Decision table in ``results["cache_plan"]``.
     auto_cache: bool = False
+    #: Placement search (core.autoshard): force the cost-model-ranked
+    #: candidate search for the block solve even when ``KEYSTONE_AUTOSHARD``
+    #: disabled it process-wide.  The searched candidate table (scores,
+    #: deny rationale, chosen plan's predicted-vs-actual cost) lands in
+    #: ``results["placement"]`` whenever a search ran.
+    auto_shard: bool = False
     #: Whole-fitted-SERVABLE-pipeline checkpoint stem (core.checkpoint):
     #: load-or-fit of ``GroupConcatFeaturizer >> model >> MaxClassifier``
     #: — the artifact the serving endpoint warm-loads.
@@ -184,6 +190,7 @@ def run(
             nvalid=nvalid,
             checkpoint=conf.solve_checkpoint,
             resume_from=conf.solve_resume,
+            plan=True if conf.auto_shard else None,
         )
         log_fit_report(solver, label="mnist random-fft solve")
         if numerics_guard_enabled():
@@ -206,6 +213,12 @@ def run(
     results: dict = {}
     if cache_plan is not None:
         results["cache_plan"] = cache_plan.record()
+    rep = solver.last_fit_report
+    if rep is not None and rep.placement is not None:
+        # The searched placement table — candidates, deny/score rationale,
+        # chosen plan with predicted-vs-actual cost (tools/plan_view.py
+        # pretty-prints it from this record).
+        results["placement"] = rep.placement
 
     def train_eval(pred):
         predicted = MaxClassifier()(pred[:n_train])
@@ -324,6 +337,14 @@ def main(argv=None):
         "(KEYSTONE_AUTOCACHE=1 equivalent)",
     )
     p.add_argument(
+        "--autoShard",
+        action="store_true",
+        help="placement search (core.autoshard): force the cost-model "
+        "ranked mesh/strategy candidate search for the block solve and "
+        "record the searched plan in results['placement'] (the search is "
+        "on by default; KEYSTONE_AUTOSHARD=0 disables it except here)",
+    )
+    p.add_argument(
         "--pipelineFile",
         default=None,
         help="fitted-SERVABLE-pipeline checkpoint stem: load-or-fit of "
@@ -356,6 +377,7 @@ def main(argv=None):
         solve_checkpoint=a.solveCheckpoint,
         solve_resume=a.resumeFrom,
         auto_cache=a.autoCache or optimize.auto_cache_env(),
+        auto_shard=a.autoShard,
         pipeline_file=a.pipelineFile,
         serve=a.serve,
         serve_bench=a.serveBench,

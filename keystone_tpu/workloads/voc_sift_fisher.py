@@ -143,6 +143,11 @@ class SIFTFisherConfig:
     # sampling and Fisher featurization, or are re-projected per consumer
     # under a tight HBM budget.  Decision table in results["cache_plan"].
     auto_cache: bool = False
+    # Placement search (core.autoshard): force the cost-model-ranked
+    # candidate search for the block solve (on by default via
+    # KEYSTONE_AUTOSHARD); the searched table lands in
+    # results["placement"] whenever a search ran.
+    auto_shard: bool = False
     # Serving modes (core.serve via serve_common): warm-load the
     # pipeline_file bundle, assemble the servable chain (grayscale ->
     # SIFT -> PCA -> Fisher features -> model), and answer/SLO-bench
@@ -231,7 +236,7 @@ def run(
     t0 = time.perf_counter()
 
     feat_dim = 2 * conf.desc_dim * conf.vocab_size
-    results_cache_plan = None
+    results_cache_plan = results_placement = None
 
     # Load-or-fit of the WHOLE fitted pipeline (SURVEY §5 generalized): when
     # the checkpoint exists, training featurization and all fits are skipped
@@ -329,10 +334,13 @@ def run(
             solver = BlockLeastSquaresEstimator(4096, 1, conf.lam, mesh=mesh)
             model = solver.fit(
                 train_features, train_labels, num_features=feat_dim,
+                plan=True if conf.auto_shard else None,
                 **solve_kwargs,
             )
             log_fit_report(solver, label="VOC SIFT-Fisher solve")
             assert_all_finite(model, "VOC block least-squares fit")
+            rep = solver.last_fit_report
+            results_placement = rep.placement if rep is not None else None
         if state_path is not None and os.path.exists(state_path):
             # The per-block state is a RESUME artifact, not a model cache:
             # leaving the completed state behind would make a later rerun
@@ -362,6 +370,10 @@ def run(
     }
     if results_cache_plan is not None:
         results["cache_plan"] = results_cache_plan
+    if results_placement is not None:
+        # The searched placement table for the block solve — candidates,
+        # deny/score rationale, chosen plan's predicted-vs-actual cost.
+        results["placement"] = results_placement
     autotune = collect_autotune(train, test)
     if autotune:
         results["autotune"] = autotune
@@ -475,6 +487,14 @@ def main(argv=None):
         "(KEYSTONE_AUTOCACHE=1 equivalent)",
     )
     p.add_argument(
+        "--autoShard",
+        action="store_true",
+        help="placement search (core.autoshard): force the cost-model "
+        "ranked mesh/strategy candidate search for the block solve and "
+        "record the searched plan in results['placement'] (on by "
+        "default; KEYSTONE_AUTOSHARD=0 disables it except here)",
+    )
+    p.add_argument(
         "--autoTune",
         action="store_true",
         help="closed-loop ingest autotuner on --streamIngest streams: "
@@ -537,6 +557,7 @@ def main(argv=None):
         pipeline_file=a.pipelineFile,
         solve_checkpoint=a.solveCheckpoint,
         auto_cache=a.autoCache or optimize.auto_cache_env(),
+        auto_shard=a.autoShard,
         serve=a.serve,
         serve_bench=a.serveBench,
         serve_clients=a.serveClients,

@@ -64,6 +64,12 @@ draws its parameters — fully deterministic):
   ``serve_burst_oom``), re-answers the same requests through smaller
   buckets, and every answer stays bit-equal — degradation, never a
   silent wrong answer and never a dead endpoint.
+* ``plan_mispredict`` — a cost-model misprediction made real: the
+  placement search's TOP-RANKED plan dies RESOURCE_EXHAUSTED at runtime
+  (injected at dispatch).  The fit must step down to the NEXT plan in the
+  searched ranking (``results["placement"]`` proves the order), count an
+  ``autoshard_stepdown``, and land predictions bit-equal to the
+  fault-free fit — a wrong cost model degrades loudly, never silently.
 """
 
 from __future__ import annotations
@@ -120,6 +126,7 @@ FAMILIES = (
     "slow_client",
     "malformed_request",
     "serve_burst_oom",
+    "plan_mispredict",
 )
 
 #: The serving-path families (core.serve), selectable via
@@ -128,8 +135,8 @@ SERVE_FAMILIES = ("slow_client", "malformed_request", "serve_burst_oom")
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(15))
-FULL_SEEDS = tuple(range(30))
+TIER1_SEEDS = tuple(range(16))
+FULL_SEEDS = tuple(range(32))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -264,6 +271,8 @@ def make_schedule(seed: int) -> Fault:
             kind,
             {"burst": int(rng.integers(9, 17)), "failures": 1},
         )
+    if kind == "plan_mispredict":
+        return Fault(kind, {"failures": 1})
     return Fault("deadline", {"seconds": 1.0})
 
 
@@ -1045,6 +1054,40 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
     if fault.kind == "serve_burst_oom":
         _serve_burst_oom_phase(fault, tmpdir, seed)
         return _run_workload(workload)
+
+    if fault.kind == "plan_mispredict":
+        # The cost model's top-ranked plan (fused, on these shapes) is made
+        # WRONG at runtime: injected RESOURCE_EXHAUSTED at its dispatch.
+        # Oracle: the fit walks to the NEXT plan in the SEARCHED ranking
+        # (the placement record proves the order), the step-down is
+        # counted, and the judge then holds predictions to bit-equality.
+        from keystone_tpu.core.resilience import counters as _counters
+
+        before = _counters.get("autoshard_stepdown")
+        with faults.oom_faults(
+            block_mod, "_execute_fused_bcd", failures=fault.params["failures"]
+        ):
+            res = _run_workload(workload)
+        placement = res.get("placement")
+        if placement is None:
+            raise ChaosOracleError(
+                "no searched placement in results — the mispredict family "
+                "requires the placement search to be active"
+            )
+        ranking, chosen = placement["ranking"], placement["chosen"]
+        if len(ranking) < 2 or chosen != ranking[1]:
+            raise ChaosOracleError(
+                f"top-ranked plan {ranking[0] if ranking else None!r} died "
+                f"but the fit chose {chosen!r}, not the next-ranked "
+                f"{ranking[1] if len(ranking) > 1 else None!r}"
+            )
+        top = placement["candidates"][0] if placement["candidates"] else {}
+        if _counters.get("autoshard_stepdown") - before < 1:
+            raise ChaosOracleError(
+                "the searched top plan died RESOURCE_EXHAUSTED but no "
+                f"autoshard_stepdown was counted (top candidate: {top})"
+            )
+        return res
 
     if fault.kind == "nan_input":
         frac = fault.params["frac"]

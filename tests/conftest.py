@@ -7,6 +7,7 @@ exercised without TPU hardware.  Must set flags before jax initializes.
 """
 
 import os
+import tempfile
 
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
@@ -14,6 +15,14 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Hermetic placement search: the plan-outcome log (core.autoshard) defaults
+# to ~/.keystone_plans.jsonl and TRAINS the cost model across processes — a
+# suite run must neither pollute the operator's log nor inherit a trained
+# ranking that deviates from the hand ladder (the bit-identical baselines
+# several suites pin).  Every test process gets a fresh, empty log.
+os.environ["KEYSTONE_PLAN_LOG"] = os.path.join(
+    tempfile.mkdtemp(prefix="keystone_plans_"), "plans.jsonl"
+)
 
 import jax  # noqa: E402
 

@@ -1,6 +1,8 @@
 """Unit tests for bench.py's measurement scaffolding (the parts that guard
 the round artifact — no TPU required)."""
 
+import json
+
 import bench
 
 
@@ -48,3 +50,62 @@ def test_timed_chain_auto_propagates_real_failures(monkeypatch):
         assert "RESOURCE_EXHAUSTED" in str(e)
     else:
         raise AssertionError("real failure was swallowed")
+
+
+def test_solve_at_scale_records_fit_report_per_attempt(monkeypatch):
+    """Regression for the PR 7 probe fix (BENCH_r05 showed raw-OOM rows
+    with no ladder evidence): every probed shape — failures INCLUDED —
+    must carry the estimator's own ``last_fit_report`` record in the
+    emitted JSON, and (ISSUE 9) the searched ``placement`` table rides in
+    it.  Every probe is made to FAIL (injected post-fit OOM, the report
+    already populated — the shape a real runtime OOM leaves) so the
+    all-attempts-failed worst case is what gets audited."""
+    import numpy as np
+
+    class FailingEstimator(bench.BlockLeastSquaresEstimator):
+        def fit(self, *args, **kwargs):
+            super().fit(*args, **kwargs)
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected probe failure")
+
+    monkeypatch.setattr(bench, "BlockLeastSquaresEstimator", FailingEstimator)
+    monkeypatch.setattr(
+        bench, "_bench_bwls_at_scale", lambda rng, shapes=None, bs=4096: {
+            "error": "stubbed", "attempts": [],
+        },
+    )
+    out = bench.bench_solve_at_scale(
+        np.random.default_rng(0), shapes=[(256, 128), (128, 128)], bs=64
+    )
+    assert out["error"] == "no probed shape fit"
+    assert len(out["attempts"]) == 2
+    for att in out["attempts"]:
+        rep = att["solver"]
+        assert rep is not None, att  # the ladder's evidence, per attempt
+        assert "RESOURCE_EXHAUSTED" in att["error"]
+        assert rep["placement"] is not None  # the searched plan (ISSUE 9)
+        assert rep["placement"]["candidates"]
+        assert rep["placement"]["ranking"]
+    json.dumps(out)  # the whole probe record must stay JSON-able
+
+
+def test_solve_at_scale_success_records_searched_plan(monkeypatch):
+    """The landing shape's record carries the searched placement with the
+    chosen plan and its predicted-vs-actual cost."""
+    import numpy as np
+
+    monkeypatch.setattr(
+        bench, "_bench_bwls_at_scale", lambda rng, shapes=None, bs=4096: {
+            "error": "stubbed", "attempts": [],
+        },
+    )
+    out = bench.bench_solve_at_scale(
+        np.random.default_rng(0), shapes=[(256, 128)], bs=64
+    )
+    assert "error" not in out
+    rep = out["solver"]
+    assert rep is not None
+    placement = rep["placement"]
+    assert placement is not None
+    assert placement["chosen"] == rep["chosen_tier"]
+    assert placement["measured_seconds"] is not None
+    json.dumps(out)

@@ -31,6 +31,7 @@ EXPECTED_COUNTER = {
     "slow_client": "chaos_slow_client",
     "malformed_request": "serve_malformed_request",
     "serve_burst_oom": "serve_burst_oom",
+    "plan_mispredict": "autoshard_stepdown",
 }
 
 
@@ -74,6 +75,9 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # Serving coverage (ISSUE 8): the typed-or-equal invariant extends to
     # the online path — slow clients, malformed requests, burst OOM
     assert set(chaos.SERVE_FAMILIES) <= kinds
+    # Placement-search coverage (ISSUE 9): a mispredicted top-ranked plan
+    # must step down the SEARCHED ranking typed + counted
+    assert "plan_mispredict" in kinds
 
 
 def test_schedules_are_deterministic():

@@ -135,6 +135,64 @@ def test_cifar_featurize_stream_equals_chunked(tmp_path, rng):
     )
 
 
+def test_cifar_tar_stream_loader_bit_identical_to_eager(tmp_path, rng):
+    """Streamed TRAIN path (ISSUE 9 satellite, ROADMAP carry-over): the
+    resident train subset decoded through core.ingest — and through the
+    snapshot cache on a warm repeat — must equal the eager tar loader
+    bit-for-bit: same images, same labels, same (tar member) order."""
+    from keystone_tpu.workloads.cifar_random_patch import (
+        cifar_tar_loader,
+        cifar_tar_stream_loader,
+    )
+    from keystone_tpu.workloads.fv_common import stream_config_from_flags
+
+    tar = str(tmp_path / "cifar48.tar")
+    _write_cifar_tar(tar, 11, rng)  # odd count: a ragged final batch
+    eager = cifar_tar_loader(tar)
+    streamed = cifar_tar_stream_loader(tar, batch=4)
+    np.testing.assert_array_equal(streamed.images, eager.images)
+    np.testing.assert_array_equal(streamed.labels, eager.labels)
+
+    # Snapshot-cache path: cold pass materializes, warm pass streams the
+    # shards at IO speed — both bit-identical to the eager loader.
+    snap = str(tmp_path / "snap")
+    cfg = lambda: stream_config_from_flags(snapshot_dir=snap)  # noqa: E731
+    cold = cifar_tar_stream_loader(tar, batch=4, config=cfg())
+    warm = cifar_tar_stream_loader(tar, batch=4, config=cfg())
+    np.testing.assert_array_equal(cold.images, eager.images)
+    np.testing.assert_array_equal(warm.images, eager.images)
+    np.testing.assert_array_equal(warm.labels, eager.labels)
+
+
+def test_cifar_run_from_streamed_train_matches_eager(tmp_path, rng):
+    """RandomPatchCifar fit from the STREAMED train split: filter learning
+    and the solve see the same resident subset, so predictions equal the
+    eager-loaded run's bit-for-bit."""
+    from keystone_tpu.workloads.cifar_random_patch import (
+        cifar_tar_loader,
+        cifar_tar_stream_loader,
+        run,
+    )
+
+    tar = str(tmp_path / "cifar48.tar")
+    _write_cifar_tar(tar, 16, rng)
+    conf = RandomCifarConfig(
+        num_filters=4,
+        patch_steps=6,
+        lam=10.0,
+        whitener_size=64,
+        featurize_chunk=8,
+        num_classes=4,
+    )
+    eager_train = cifar_tar_loader(tar)
+    streamed_train = cifar_tar_stream_loader(tar, batch=8)
+    base = run(conf, eager_train, eager_train)
+    res = run(conf, streamed_train, eager_train)
+    np.testing.assert_array_equal(
+        res["test_predictions"], base["test_predictions"]
+    )
+
+
 @pytest.mark.slow
 def test_cifar_run_with_stream_test_tar_matches_eager(tmp_path, rng):
     """Full RandomPatchCifar run with the streamed test path: predictions

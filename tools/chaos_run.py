@@ -76,6 +76,15 @@ def main(argv=None) -> int:
     )
     a = p.parse_args(argv)
 
+    # Hermetic placement search: the plan_mispredict oracle (and every
+    # bit-equality judge) assumes the COLD search ranking — a trained
+    # operator log could legitimately put a different plan at the head,
+    # and the harness's synthetic fits must not train the real one.
+    # Same posture as tests/conftest.py.
+    from keystone_tpu.core.autoshard import hermetic_plan_log
+
+    hermetic_plan_log()
+
     import chaos
 
     if a.seed is not None:

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Pretty-print placement-search decisions (core.autoshard output).
+
+Two input kinds:
+
+* a **results JSON** — a workload's ``results["placement"]``, a bench
+  record (``extra_metrics.solve_at_scale...solver.placement``,
+  ``extra_metrics.placement.shapes[*]``), or any JSON containing
+  ``FitReport.record()`` output: every embedded ``PlacementPlan`` record
+  is found recursively and printed as a candidate table — rank, mesh,
+  predicted cost with its calibration provenance, deny reason for pruned
+  candidates, and the chosen plan's predicted-vs-actual cost;
+* the **plan-outcome log** (``~/.keystone_plans.jsonl`` /
+  ``KEYSTONE_PLAN_LOG``, any ``*.jsonl`` path): measured outcomes grouped
+  by program fingerprint and candidate — sample counts, ok/oom split, and
+  the median measured/predicted ratio (the learned calibration the next
+  process will apply).
+
+Usage:
+    python tools/plan_view.py results.json
+    python tools/plan_view.py ~/.keystone_plans.jsonl [--fingerprint FP]
+
+No jax import — this reads JSON artifacts, it never touches a device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+#: keys that identify a dict as a PlacementPlan record
+_PLAN_KEYS = {"fingerprint", "candidates", "ranking"}
+
+
+def find_plans(doc) -> list:
+    """Every embedded ``PlacementPlan.record()`` dict, depth-first."""
+    out = []
+    if isinstance(doc, dict):
+        if _PLAN_KEYS <= set(doc) and isinstance(doc.get("candidates"), list):
+            out.append(doc)
+        else:
+            for v in doc.values():
+                out.extend(find_plans(v))
+    elif isinstance(doc, list):
+        for v in doc:
+            out.extend(find_plans(v))
+    return out
+
+
+def _fmt_s(v) -> str:
+    return f"{v:.4g}s" if isinstance(v, (int, float)) else "-"
+
+
+def format_plan(plan: dict) -> str:
+    """One PlacementPlan record as a human-readable candidate table."""
+    lines = [
+        f"## {plan.get('label', '?')} [{plan.get('fingerprint', '?')}] "
+        f"on {plan.get('devices', '?')} — "
+        f"{'trained' if plan.get('trained') else 'untrained'} model, "
+        f"margin {plan.get('margin')}x, search "
+        f"{_fmt_s(plan.get('search_seconds'))}"
+    ]
+    header = (
+        f"{'rank':>4} {'candidate':<28} {'kind':<12} {'mesh':<8} "
+        f"{'predicted':>10} {'calib':>7} {'n':>3} {'measured':>10} "
+        f"{'outcome':<8} note"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    chosen = plan.get("chosen")
+    # execution order first (ranked), then pruned-and-dropped candidates
+    cands = sorted(
+        plan.get("candidates", []),
+        key=lambda c: (c.get("rank") is None, c.get("rank") or 0,
+                       c.get("prior_rank", 0)),
+    )
+    for c in cands:
+        mesh = c.get("mesh")
+        mesh_s = (
+            f"{mesh.get('data', '?')}x{mesh.get('model', '?')}" if mesh else "-"
+        )
+        mark = "*" if c.get("name") == chosen else " "
+        note = ""
+        if c.get("pruned"):
+            note = f"PRUNED: {c.get('reason', '')}"
+        lines.append(
+            f"{c.get('rank') if c.get('rank') is not None else '-':>4}"
+            f"{mark}{c.get('name', '?'):<27} {c.get('kind', '?'):<12} "
+            f"{mesh_s:<8} {_fmt_s(c.get('predicted_seconds')):>10} "
+            f"{c.get('calibration', 1.0):>7.3g} {c.get('samples', 0):>3} "
+            f"{_fmt_s(c.get('measured_seconds')):>10} "
+            f"{c.get('outcome') or '-':<8} {note}"
+        )
+    if chosen is not None:
+        pe = plan.get("prediction_error")
+        lines.append(
+            f"chosen: {chosen} — predicted "
+            f"{_fmt_s(plan.get('predicted_seconds'))}, measured "
+            f"{_fmt_s(plan.get('measured_seconds'))}"
+            + (f", prediction_error {pe}x" if pe is not None else "")
+        )
+    return "\n".join(lines)
+
+
+def load_log(path: str) -> list:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # a torn tail line is not an error
+    return records
+
+
+def format_log(records: list, fingerprint: str | None = None) -> str:
+    """The outcome log grouped by (fingerprint, candidate): what the
+    learned calibration will be next process."""
+    groups: dict = defaultdict(list)
+    for r in records:
+        fp = r.get("fingerprint", "?")
+        if fingerprint is not None and fp != fingerprint:
+            continue
+        groups[(fp, r.get("label", "?"), r.get("candidate", "?"))].append(r)
+    if not groups:
+        return "(no matching outcome records)"
+    lines = [
+        f"{'fingerprint':<18} {'label':<12} {'candidate':<28} {'n':>4} "
+        f"{'ok':>4} {'oom':>4} {'med(meas/pred)':>15}"
+    ]
+    lines.append("-" * len(lines[0]))
+    for (fp, label, cand), rs in sorted(groups.items()):
+        ratios = sorted(
+            r["measured_seconds"] / r["predicted_seconds"]
+            for r in rs
+            if r.get("outcome") == "ok"
+            and r.get("predicted_seconds") and r.get("measured_seconds")
+        )
+        med = ratios[len(ratios) // 2] if ratios else None
+        ok = sum(1 for r in rs if r.get("outcome") == "ok")
+        oom = sum(1 for r in rs if r.get("outcome") == "oom")
+        lines.append(
+            f"{fp:<18} {label:<12} {cand:<28} {len(rs):>4} {ok:>4} "
+            f"{oom:>4} {f'{med:.3g}x' if med is not None else '-':>15}"
+        )
+    return "\n".join(lines)
+
+
+def summarize(path: str, fingerprint: str | None = None) -> str:
+    if path.endswith(".jsonl"):
+        return format_log(load_log(path), fingerprint)
+    with open(path) as f:
+        doc = json.load(f)
+    plans = find_plans(doc)
+    if not plans:
+        return f"(no PlacementPlan records found in {path})"
+    return "\n\n".join(format_plan(p) for p in plans)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("plan_view")
+    p.add_argument(
+        "path",
+        help="results JSON (embedded PlacementPlan records) or the "
+        "plan-outcome .jsonl log",
+    )
+    p.add_argument(
+        "--fingerprint",
+        default=None,
+        help="log mode: only this program fingerprint",
+    )
+    a = p.parse_args(argv)
+    print(summarize(a.path, a.fingerprint))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
