@@ -38,17 +38,43 @@ strategy (fused / stepwise / host-staged) — the search
    cost of the chosen plan in the :class:`PlacementPlan` attached to the
    solver's ``FitReport``.
 
+**Sharding specs are executable** (ISSUE 10): :func:`spec_candidates`
+enumerates per-operand shardings from an aval's own dimensions, and
+:func:`spec_pspec` / :func:`spec_sharding` lower a chosen spec string
+(``"data@dim0"``, ``"model@dim1"``, ``"replicated"``) into the actual
+``PartitionSpec`` / ``NamedSharding`` the mesh programs constrain their
+operands with — so a :class:`Candidate` can carry a per-operand spec
+assignment (``Candidate.specs``) that the solvers execute as a REAL
+layout, not just a byte estimate.  The candidate space is then
+(mesh factorization x strategy x spec assignment), still pruned by the
+same zero-cost batch preflight (which already charges spec bytes) and
+still run through the unchanged ``run_ladder`` contract.
+``KEYSTONE_AUTOSHARD_SPECS=0`` restores the PR 9 posture (one hard-coded
+layout per strategy; the spec dimension drops out of the enumeration).
+
+**Calibration is cross-program** (ISSUE 10): below :data:`MIN_TRAIN`
+direct measurements, a candidate's factor comes from a featurized ratio
+regression (core.optimize.CalibrationModel) fitted over EVERY program's
+logged outcomes — operand bytes, mesh axes, strategy, arithmetic
+intensity from the roofline prior — so learning on one solve shape
+transfers to unseen shapes.  The conservative-margin rules are
+unchanged: only direct measurements tighten the margin, and an empty log
+reproduces the hand ladder bit-for-bit.
+
 ``KEYSTONE_AUTOSHARD=0`` restores the hand ladders; ``fit(plan=...)``
 overrides per call (``False`` hand, ``True`` force search, a
 :class:`PlacementPlan` or name list replays a previous ranking).
-``KEYSTONE_PLAN_LOG`` points the outcome log elsewhere (``off`` disables).
-The log is read ONCE per process: outcomes appended during a run train the
-NEXT process, so a ranking can never silently change between a baseline
-and a comparison fit inside one process (the chaos bit-equality bar).
+``KEYSTONE_PLAN_LOG`` points the outcome log elsewhere (``off`` disables);
+``KEYSTONE_PLAN_LOG_MAX`` caps its entry count (oldest-first compaction on
+write).  The log is read ONCE per process: outcomes appended during a run
+train the NEXT process, so a ranking can never silently change between a
+baseline and a comparison fit inside one process (the chaos bit-equality
+bar).
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import json
@@ -69,10 +95,19 @@ _logger = logging.getLogger("keystone_tpu.autoshard")
 #: env var: "0"/"off"/"false" restores the hand ladders process-wide.
 AUTOSHARD_ENV = "KEYSTONE_AUTOSHARD"
 
+#: env var: "0"/"off"/"false" drops the per-operand SPEC dimension from
+#: the candidate enumeration (the PR 9 posture: one layout per strategy).
+SPECS_ENV = "KEYSTONE_AUTOSHARD_SPECS"
+
 #: env var: plan-outcome log path; default ``~/.keystone_plans.jsonl``;
 #: "0"/"off"/"none" disables persistence.
 PLAN_LOG_ENV = "KEYSTONE_PLAN_LOG"
 _DEFAULT_PLAN_LOG = "~/.keystone_plans.jsonl"
+
+#: env var: plan-outcome log entry cap (oldest-first compaction on write);
+#: "0"/"off" disables capping.
+PLAN_LOG_MAX_ENV = "KEYSTONE_PLAN_LOG_MAX"
+_DEFAULT_PLAN_LOG_MAX = 20_000
 
 #: measurements per (fingerprint, candidate) before its calibration counts.
 MIN_TRAIN = 3
@@ -94,6 +129,15 @@ def enabled() -> bool:
     """Search is the default; ``KEYSTONE_AUTOSHARD=0`` restores the hand
     ladders."""
     return os.environ.get(AUTOSHARD_ENV, "").strip().lower() not in (
+        "0", "off", "false",
+    )
+
+
+def specs_enabled() -> bool:
+    """Spec-assignment candidates are enumerated by default when the
+    search runs; ``KEYSTONE_AUTOSHARD_SPECS=0`` restores the PR 9
+    one-layout-per-strategy candidate space."""
+    return os.environ.get(SPECS_ENV, "").strip().lower() not in (
         "0", "off", "false",
     )
 
@@ -163,6 +207,82 @@ def best_spec(aval, mesh_shape: dict) -> dict:
     return min(cands, key=lambda c: (c["per_chip_bytes"], c["spec"]))
 
 
+# -- spec strings -> executable layouts ----------------------------------------
+#
+# A spec string names ONE mesh axis over ONE operand dimension
+# ("data@dim0", "model@dim1") or full replication ("replicated") — the
+# exact vocabulary :func:`spec_candidates` enumerates from avals.  The
+# lowerers below turn a CHOSEN spec into the jax objects the mesh
+# programs execute with, so the byte accounting and the executed layout
+# can never drift: both read the same string.
+
+
+def spec_pspec(spec: str, ndim: int):
+    """Lower one spec string to the ``PartitionSpec`` it names."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    if spec == "replicated":
+        return P(*([None] * ndim))
+    axis, sep, dim = spec.partition("@dim")
+    if not sep or axis not in ("data", "model") or not dim.isdigit():
+        raise ValueError(
+            f"bad sharding spec {spec!r} (want 'replicated', 'data@dimN' "
+            "or 'model@dimN')"
+        )
+    i = int(dim)
+    if i >= ndim:
+        raise ValueError(f"spec {spec!r} names dim {i} of a {ndim}-d operand")
+    parts: list = [None] * ndim
+    parts[i] = DATA_AXIS if axis == "data" else MODEL_AXIS
+    return P(*parts)
+
+
+def spec_sharding(spec: str, mesh, ndim: int):
+    """Lower one spec string to a ``NamedSharding`` on ``mesh`` — the
+    layout the solvers constrain an operand with when a spec-assignment
+    candidate executes."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, spec_pspec(spec, ndim))
+
+
+def spec_chip_bytes(shape, dtype, spec: str, mesh_shape: dict) -> int:
+    """Analytic per-chip bytes of one operand under one spec — the figure
+    a spec-assignment candidate's hints charge (and the quantity the
+    lower-bound regression test pins against the compiled
+    ``memory_analysis``).  The named dimension must divide evenly; callers
+    enumerate via :func:`spec_candidates`, which only emits legal specs."""
+    shape = tuple(int(d) for d in shape)
+    total = int(np.prod(shape)) * np.dtype(dtype).itemsize if shape else (
+        np.dtype(dtype).itemsize
+    )
+    if spec == "replicated":
+        return total
+    axis, _, dim = spec.partition("@dim")
+    size = int(mesh_shape.get(axis, 1))
+    n = shape[int(dim)]
+    if size <= 1:
+        return total
+    if n % size:
+        raise ValueError(
+            f"spec {spec!r} does not divide dim of size {n} by {size}"
+        )
+    return total // size
+
+
+def spec_tag(specs: dict | None) -> str:
+    """Compact human tag for a spec assignment (candidate names, the
+    plan_view spec column): ``'labels=model@dim1,models=rep'``."""
+    if not specs:
+        return "default"
+    return ",".join(
+        f"{k}={'rep' if v == 'replicated' else v}"
+        for k, v in sorted(specs.items())
+    )
+
+
 # -- the plan-outcome log ------------------------------------------------------
 
 
@@ -191,10 +311,139 @@ def hermetic_plan_log() -> str:
     return path
 
 
+def plan_log_max() -> int | None:
+    """Entry cap on the plan-outcome log (``KEYSTONE_PLAN_LOG_MAX``;
+    default 20k, ``0``/``off`` disables).  Raises ``ValueError`` for a
+    malformed or negative value (same fail-fast grammar as the other
+    ``KEYSTONE_*`` numeric knobs); the append path catches it — telemetry
+    never crashes a solve."""
+    raw = os.environ.get(PLAN_LOG_MAX_ENV, "").strip()
+    if not raw:
+        return _DEFAULT_PLAN_LOG_MAX
+    if raw.lower() in ("0", "off", "none"):
+        return None
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{PLAN_LOG_MAX_ENV}={raw!r} is not an integer"
+        ) from None
+    if val < 1:
+        raise ValueError(f"{PLAN_LOG_MAX_ENV}={raw!r} must be >= 1 (or 'off')")
+    return val
+
+
+#: newest records kept per (fingerprint, candidate) when compaction must
+#: drop history: enough for a stable median over MIN_TRAIN-sized tails
+#: (an odd count keeps the median an actual sample).
+_COMPACT_KEEP_PAIR = 9
+
+
+@contextlib.contextmanager
+def _log_lock(path: str):
+    """Advisory exclusive lock (sidecar ``<path>.lock``) serializing log
+    appends against compaction's read-rewrite-replace: without it, a
+    record another process appends between compaction's read and its
+    ``os.replace`` would vanish silently.  Best-effort — platforms
+    without ``fcntl`` (or an unwritable sidecar) fall back to unlocked
+    appends, the pre-cap behavior."""
+    lf = None
+    try:
+        try:
+            import fcntl
+
+            lf = open(path + ".lock", "a")
+            fcntl.flock(lf, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            lf = None
+        yield
+    finally:
+        if lf is not None:
+            try:
+                lf.close()  # closing the fd releases the flock
+            except OSError:
+                pass
+
+
+def compact_log(path: str, cap: int) -> int:
+    """Oldest-first compaction of the outcome log to a watermark BELOW
+    ``cap`` (~90%, so the headroom amortizes the next O(entries) recount
+    across many appends instead of re-reading per append once the log
+    saturates).  Three passes: (1) per (fingerprint, candidate) pair,
+    drop all but the newest :data:`_COMPACT_KEEP_PAIR` records — the
+    median the calibration reads is computed over a pair's newest ratios,
+    so trimming a pair's deep history leaves its factor stable; (2) if
+    still over the watermark, evict whole pairs, least-recently-written
+    first — but never the last one; (3) a lone surviving pair still over
+    the watermark trims to its newest records.  The log is never wiped
+    outright, whatever the cap.  Atomic rewrite (tmp + rename); returns
+    the surviving record count."""
+    with _log_lock(path):
+        return _compact_locked(path, cap)
+
+
+def _compact_locked(path: str, cap: int) -> int:
+    try:
+        with open(path) as f:
+            lines = [ln for ln in (l.strip() for l in f) if ln]
+    except OSError:
+        return 0
+    if len(lines) <= cap:
+        return len(lines)
+    target = max(1, cap - max(1, cap // 10))
+    parsed: list = []
+    for i, ln in enumerate(lines):
+        try:
+            r = json.loads(ln)
+        except json.JSONDecodeError:
+            continue  # a torn line never survives compaction
+        parsed.append((i, (r.get("fingerprint"), r.get("candidate")), ln))
+    by_pair: dict = {}
+    for i, pair, ln in parsed:
+        by_pair.setdefault(pair, []).append((i, ln))
+    # pass 1: newest records per pair (file order = age order); a tiny
+    # cap bounds the per-pair tail too, so one pair cannot overflow it
+    keep = max(1, min(_COMPACT_KEEP_PAIR, target))
+    kept_pairs = {p: rows[-keep:] for p, rows in by_pair.items()}
+    # pass 2: whole-pair eviction, least-recently-written pair first
+    pairs_by_recency = sorted(kept_pairs, key=lambda p: kept_pairs[p][-1][0])
+    total = sum(len(rows) for rows in kept_pairs.values())
+    for p in pairs_by_recency:
+        if total <= target or len(kept_pairs) == 1:
+            break
+        total -= len(kept_pairs.pop(p))
+    if total > target:  # pass 3: one pair left — trim, never wipe
+        p = next(iter(kept_pairs))
+        kept_pairs[p] = kept_pairs[p][-target:]
+    survivors = sorted(
+        (row for rows in kept_pairs.values() for row in rows),
+        key=lambda row: row[0],
+    )
+    tmp = f"{path}.compact.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write("".join(ln + "\n" for _i, ln in survivors))
+    os.replace(tmp, path)
+    return len(survivors)
+
+
+#: floor on one serialized outcome record's size — the unit converting
+#: "entries of headroom" into "bytes of growth" for the cap prechecks.
+_MIN_RECORD_BYTES = 64
+
+#: path -> byte size below which the file PROVABLY holds <= cap entries
+#: (set after each count: current size + headroom * _MIN_RECORD_BYTES).
+#: Bounds the O(entries) recount to once per cap's-worth of growth
+#: instead of once per append — the append path is a solve's finish path.
+_compact_skip: dict[str, int] = {}
+
+
 def append_outcome(record: dict) -> None:
-    """Best-effort append of one plan outcome to the persistent log.  A
-    broken log path degrades counted (``plan_log_write_failed``) — the
-    solve's result never depends on telemetry landing."""
+    """Best-effort append of one plan outcome to the persistent log,
+    compacting first when the log exceeds ``KEYSTONE_PLAN_LOG_MAX``
+    entries (oldest records give way; per-pair median tails survive).  A
+    broken log path — or a malformed cap env — degrades counted
+    (``plan_log_write_failed``): the solve's result never depends on
+    telemetry landing."""
     path = plan_log_path()
     if path is None:
         return
@@ -202,9 +451,35 @@ def append_outcome(record: dict) -> None:
         parent = os.path.dirname(os.path.abspath(path))
         if parent:
             os.makedirs(parent, exist_ok=True)
-        with open(path, "a") as f:
-            f.write(json.dumps(record) + "\n")
-    except OSError as e:
+        cap = plan_log_max()
+        with _log_lock(path):
+            # The whole cap-check + compact + append sequence holds the
+            # log lock, so compaction's read-rewrite-replace can never
+            # swallow a record another process appends concurrently.
+            if cap is not None and os.path.exists(path):
+                size = os.path.getsize(path)
+                floor = max(
+                    cap * _MIN_RECORD_BYTES, _compact_skip.get(path, 0)
+                )
+                if size > floor:
+                    kept = _compact_locked(path, cap)
+                    # Convert the entry headroom the watermark bought
+                    # into bytes of growth using the OBSERVED mean record
+                    # size (floored at _MIN_RECORD_BYTES) — real records
+                    # carry the feature vector and run ~400-600 bytes, so
+                    # the 64-byte floor alone would re-trigger the
+                    # O(entries) recount within a couple of appends on a
+                    # saturated log.
+                    size_now = os.path.getsize(path)
+                    rec_bytes = max(
+                        _MIN_RECORD_BYTES, size_now // max(1, kept)
+                    )
+                    _compact_skip[path] = size_now + (
+                        max(0, cap - kept) * rec_bytes
+                    )
+            with open(path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+    except (OSError, ValueError) as e:
         counters.record("plan_log_write_failed", f"{path}: {e}")
 
 
@@ -243,23 +518,30 @@ def clear_outcome_cache() -> None:
     """Test seam: forget the once-per-process log read."""
     _outcome_cache.clear()
     _ratio_cache.clear()
+    _model_cache.clear()
+    _compact_skip.clear()
 
 
-#: path -> ({(fingerprint, candidate): ratios}, {fingerprint: ratios}) —
-#: one pass over the log per process instead of a rescan per candidate
-#: (the search's O(candidates) calibration lookups must stay O(1) against
-#: a log grown toward _MAX_LOG_RECORDS, or the scan itself would eat the
-#: <5% search-overhead budget).
-_ratio_cache: dict[str, tuple[dict, dict]] = {}
+#: path -> ({(fingerprint, candidate): ratios}, {fingerprint: ratios},
+#: model_rows) — one pass over the log per process instead of a rescan per
+#: candidate (the search's O(candidates) calibration lookups must stay
+#: O(1) against a log grown toward _MAX_LOG_RECORDS, or the scan itself
+#: would eat the <5% search-overhead budget).
+_ratio_cache: dict[str, tuple[dict, dict, list]] = {}
+
+#: path -> fitted cross-program model (or None when the log cannot
+#: support one) — the regression is fit once per process, like the read.
+_model_cache: dict[str, object] = {}
 
 
-def _ratio_index(path: str | None) -> tuple[dict, dict]:
+def _ratio_index(path: str | None) -> tuple[dict, dict, list]:
     key = path if path is not None else (plan_log_path() or "")
     cached = _ratio_cache.get(key)
     if cached is not None:
         return cached
     by_pair: dict = {}
     by_fp: dict = {}
+    rows: list = []
     for r in load_outcomes(path):
         if not (
             r.get("outcome") == "ok"
@@ -267,36 +549,124 @@ def _ratio_index(path: str | None) -> tuple[dict, dict]:
             and r.get("measured_seconds")
         ):
             continue
+        # The regression learns measured vs the RAW analytic prior (the
+        # quantity features describe); pre-calibration records fall back
+        # to predicted (factor 1.0 at the time, so the two coincide).
         ratio = r["measured_seconds"] / r["predicted_seconds"]
         fp = r.get("fingerprint")
         by_pair.setdefault((fp, r.get("candidate")), []).append(ratio)
         by_fp.setdefault(fp, []).append(ratio)
-    _ratio_cache[key] = (by_pair, by_fp)
-    return by_pair, by_fp
+        feats = r.get("features")
+        raw = r.get("raw_seconds")
+        if isinstance(feats, dict) and feats:
+            rows.append((
+                fp,
+                feats,
+                r["measured_seconds"] / raw if raw else ratio,
+            ))
+    _ratio_cache[key] = (by_pair, by_fp, rows)
+    return by_pair, by_fp, rows
+
+
+def model_rows(path: str | None = None) -> list:
+    """The cross-program training rows the log holds:
+    ``[(fingerprint, features, measured/raw_ratio)]`` over successful
+    outcomes that carried a feature vector (bench drives the
+    trained-on-A-predicted-on-B error from these)."""
+    return list(_ratio_index(path)[2])
+
+
+def _cross_program_model(path: str | None):
+    """The fitted cross-program calibration (core.optimize
+    CalibrationModel), or ``None`` when the log holds too few featurized
+    outcomes or only one program — transfer needs >= 2 fingerprints by
+    definition, and a single-program fit would just shadow the pooled
+    median with extra variance."""
+    key = path if path is not None else (plan_log_path() or "")
+    if key in _model_cache:
+        return _model_cache[key]
+    rows = _ratio_index(path)[2]
+    model = None
+    if (
+        len(rows) >= kopt.MIN_MODEL_ROWS
+        and len({fp for fp, _f, _r in rows}) >= 2
+    ):
+        model = kopt.CalibrationModel.fit_rows(rows)
+    _model_cache[key] = model
+    return model
+
+
+def plan_features(kind: str, mesh_axes: dict | None, hints: dict) -> dict:
+    """Featurize one candidate for the cross-program calibration model:
+    log-domain operand bytes / FLOPs / dispatches / transfer volumes, the
+    mesh factorization, the arithmetic intensity the roofline prior sees,
+    and the strategy kind — the quantities that transfer between solve
+    shapes, unlike a (fingerprint, candidate) key."""
+    b = lambda k: float(hints.get(k, 0) or 0)  # noqa: E731
+    touched = b("arg_bytes") + b("temp_bytes") + b("out_bytes")
+    flops = b("flops")
+    feats = {
+        "kind": kind,
+        "log_bytes": float(np.log1p(touched)),
+        "log_flops": float(np.log1p(flops)),
+        "log_dispatches": float(np.log1p(b("dispatches") or 1.0)),
+        "log_h2d": float(np.log1p(b("h2d_bytes"))),
+        "log_coll": float(np.log1p(b("coll_bytes"))),
+        "log_ai": float(np.log((flops + 1.0) / (touched + 1.0))),
+        "data_axis": float((mesh_axes or {}).get("data", 1)),
+        "model_axis": float((mesh_axes or {}).get("model", 1)),
+    }
+    return feats
+
+
+def calibrate(
+    fp: str,
+    candidate: str,
+    features: dict | None = None,
+    path: str | None = None,
+) -> tuple[float, int, str]:
+    """``(factor, direct_samples, source)`` for one candidate.
+
+    Priority ladder — most specific evidence first, each rung a strict
+    superset of what the rung below knows:
+
+    1. **direct** — >= :data:`MIN_TRAIN` measured outcomes of THIS
+       (fingerprint, candidate) pair: their median ratio (the PR 9 rule,
+       and the only rung that tightens the ranking margin);
+    2. **model** — the cross-program regression
+       (:func:`_cross_program_model`) evaluated on the candidate's
+       features: learning from OTHER programs/shapes transfers here;
+    3. **pooled** — the program-level median (every candidate of the
+       fingerprint pooled): a CONSTANT factor across uncalibrated
+       siblings, shifting absolute predictions toward honesty without
+       reordering them;
+    4. **none** — factor 1.0 (the raw analytic prior stands).
+
+    Training is one-sided — only plans that actually RAN log outcomes —
+    which is why rungs 2-3 exist: without them the measured winner would
+    absorb its real slowdown while unmeasured competitors kept optimistic
+    raw priors, and the ranking would drift toward whatever never ran.
+    The returned sample count is the DIRECT count — it drives the
+    per-pair trained margin, which no fallback rung may tighten."""
+    by_pair, by_fp, _rows = _ratio_index(path)
+    direct = by_pair.get((fp, candidate), ())
+    if len(direct) >= MIN_TRAIN:
+        return float(np.median(direct)), len(direct), "direct"
+    if features is not None:
+        model = _cross_program_model(path)
+        if model is not None:
+            return model.predict_factor(features), len(direct), "model"
+    pooled = by_fp.get(fp, ())
+    if len(pooled) >= MIN_TRAIN:
+        return float(np.median(pooled)), len(direct), "pooled"
+    return 1.0, len(direct), "none"
 
 
 def calibration(fp: str, candidate: str, path: str | None = None) -> tuple[float, int]:
-    """``(factor, direct_samples)`` for one (fingerprint, candidate) pair:
-    the median measured/predicted ratio over the log's successful outcomes.
-
-    Training is one-sided — only plans that actually RAN log outcomes — so
-    below :data:`MIN_TRAIN` direct samples the factor falls back to the
-    PROGRAM-level median (every candidate of the fingerprint pooled): a
-    CONSTANT factor across all uncalibrated siblings, which shifts their
-    absolute predictions toward honesty without ever reordering them.
-    Without the fallback, the measured winner would absorb its real
-    slowdown while unmeasured competitors kept optimistic raw priors, and
-    the ranking would drift toward whatever never ran.  The returned
-    sample count is the DIRECT count — it drives the per-pair trained
-    margin, which a pooled fallback must not tighten."""
-    by_pair, by_fp = _ratio_index(path)
-    direct = by_pair.get((fp, candidate), ())
-    if len(direct) >= MIN_TRAIN:
-        return float(np.median(direct)), len(direct)
-    pooled = by_fp.get(fp, ())
-    if len(pooled) >= MIN_TRAIN:
-        return float(np.median(pooled)), len(direct)
-    return 1.0, len(direct)
+    """Back-compat view of :func:`calibrate` without features (direct ->
+    pooled -> 1.0): ``(factor, direct_samples)``."""
+    factor, n, _source = calibrate(fp, candidate, path=path)
+    return factor, n
 
 
 # -- candidates and the plan record --------------------------------------------
@@ -319,6 +689,12 @@ class Candidate:
     prior_rank: int = 0  #: hand-ladder position (ties resolve to this)
     floor: bool = False  #: the resilience backstop — always ranked last
     hand: bool = True  #: hand-ladder member (its prunes land in FitReport)
+    #: per-operand sharding-spec assignment this candidate EXECUTES
+    #: (operand name -> spec string, e.g. {"labels": "model@dim1"});
+    #: ``None`` = the strategy's default layout.  The solver's run closure
+    #: lowers these through :func:`spec_sharding` — the same strings the
+    #: hints' byte accounting charged.
+    specs: dict | None = None
 
 
 @dataclasses.dataclass
@@ -334,10 +710,17 @@ class CandidateRecord:
     predicted_seconds: float | None = None
     raw_seconds: float | None = None  #: analytic prior before calibration
     calibration: float = 1.0
-    samples: int = 0  #: measured outcomes behind the calibration
+    samples: int = 0  #: DIRECT measured outcomes behind the calibration
+    #: which rung produced the factor: "direct" | "model" | "pooled" | "none"
+    calibration_source: str = "none"
     rank: int | None = None  #: position in the execution ranking
     measured_seconds: float | None = None  #: filled when this plan RAN
     outcome: str | None = None  #: "ok" | "oom" | "denied" after the run
+    #: the spec assignment this candidate executes (None = default layout)
+    specs: dict | None = None
+    #: cross-program feature vector (what the calibration model consumed
+    #: and the outcome log persists for the NEXT process's training)
+    features: dict | None = None
 
     def record(self) -> dict:
         out = dataclasses.asdict(self)
@@ -345,6 +728,11 @@ class CandidateRecord:
             if out[k] is not None:
                 out[k] = round(out[k], 6)
         out["calibration"] = round(self.calibration, 4)
+        if out["features"] is not None:
+            out["features"] = {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in out["features"].items()
+            }
         return out
 
 
@@ -505,17 +893,25 @@ def search(
                 prior_rank=c.prior_rank,
                 pruned=not mp.admitted and not c.floor,
                 reason=mp.reason,
+                specs=dict(c.specs) if c.specs else None,
             )
             records.append(rec)
             if rec.pruned:
                 rec.outcome = "denied"
                 continue
-            # 2. score: analytic roofline prior x learned calibration.
+            # 2. score: analytic roofline prior x learned calibration
+            # (direct median, else the cross-program feature regression,
+            # else the program-pooled median — see calibrate()).
             raw = model.predict_seconds(c.hints)
-            factor, samples = calibration(fingerprint, c.name)
+            feats = plan_features(c.kind, c.mesh_axes, c.hints)
+            factor, samples, source = calibrate(
+                fingerprint, c.name, features=feats
+            )
             rec.raw_seconds = raw
             rec.calibration = factor
             rec.samples = samples
+            rec.calibration_source = source
+            rec.features = feats
             rec.predicted_seconds = raw * factor
             if samples < MIN_TRAIN:
                 trained = False
@@ -534,7 +930,8 @@ def search(
             rec.reason = (
                 f"rank {i}: predicted {rec.predicted_seconds:.4g}s "
                 f"(prior {rec.raw_seconds:.4g}s x calibration "
-                f"{rec.calibration:.3g} from {rec.samples} outcome(s))"
+                f"{rec.calibration:.3g} [{rec.calibration_source}] from "
+                f"{rec.samples} direct outcome(s))"
                 + (" [floor: pinned last]" if c.floor else "")
             )
         # Pruned HAND candidates stay in the execution order at their hand
@@ -685,9 +1082,19 @@ def run_search(
                 predicted_seconds=rec.predicted_seconds if rec else None,
                 label=label,
                 rank=rec.rank if rec else None,
+                specs=spec_tag(rec.specs if rec else None),
             ):
                 try:
                     out = c.run(mplan)
+                    # Sync before reading the clock: a fused program's run
+                    # returns async-dispatched arrays, so an unsynced
+                    # measurement records ~0s dispatch time — garbage that
+                    # would train the calibration model toward "free".
+                    # The sync also surfaces an ASYNC runtime
+                    # RESOURCE_EXHAUSTED here, inside the ladder's try,
+                    # so it steps down counted instead of escaping at the
+                    # caller's first use of the result.
+                    _block_until_ready(out)
                 except Exception:
                     measured[c.name] = time.perf_counter() - t0
                     raise
@@ -702,6 +1109,19 @@ def run_search(
     finally:
         _finish(placement, report, measured, fingerprint, label)
     return out
+
+
+def _block_until_ready(out) -> None:
+    """Best-effort sync on a tier run's result pytree (measurement
+    honesty + async-OOM surfacing; a result that cannot sync — or no
+    live backend — is not an error)."""
+    try:
+        import jax
+
+        jax.block_until_ready(out)
+    except Exception as e:  # noqa: BLE001 — only OOM matters here
+        if kmem.is_oom_error(e):
+            raise
 
 
 def _finish(placement, report, measured, fp, label) -> None:
@@ -728,9 +1148,14 @@ def _finish(placement, report, measured, fp, label) -> None:
             "label": label,
             "candidate": name,
             "predicted_seconds": rec.predicted_seconds,
+            "raw_seconds": rec.raw_seconds,
             "measured_seconds": secs,
             "outcome": rec.outcome,
             "devices": placement.devices,
+            "specs": rec.specs,
+            # the cross-program training row: the NEXT process's
+            # CalibrationModel regresses measured/raw on these.
+            "features": rec.features,
             "ts": time.time(),
         })
     chosen_rec = (

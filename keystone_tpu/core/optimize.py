@@ -496,6 +496,112 @@ class CostModel:
         )
 
 
+# -- the cross-program calibration model (ISSUE 10) ---------------------------
+
+#: featurized outcomes required before a cross-program fit is attempted
+#: (core.autoshard additionally requires >= 2 distinct program
+#: fingerprints — transfer between programs is the model's entire point).
+MIN_MODEL_ROWS = 8
+
+#: one-sided bound on the learned factor: a regression extrapolating onto
+#: a feature vector far outside its training hull must not predict a
+#: thousandfold slowdown/speedup and blow a candidate past every margin.
+_FACTOR_CLIP = 32.0
+
+
+@dataclasses.dataclass
+class CalibrationModel:
+    """Cross-program calibration: ridge regression of
+    ``log(measured / analytic-prior)`` on candidate FEATURES (operand
+    bytes, mesh factorization, strategy kind, arithmetic intensity — see
+    ``core.autoshard.plan_features``), fitted over every program's logged
+    outcomes.
+
+    This replaces PR 9's per-(fingerprint, candidate) memorization as the
+    below-:data:`~keystone_tpu.core.autoshard.MIN_TRAIN` fallback: a
+    median keyed on the program fingerprint cannot say anything about a
+    shape it never ran, while a feature-space fit transfers — train on a
+    16k x 2k solve, predict the ratio for an 8k x 4k one (the Learned
+    Cost Model placement direction, PAPERS.md).  Direct per-pair medians
+    still win once they exist, and only THEY tighten the ranking margin;
+    the model only shifts absolute predictions toward honesty, bounded by
+    :data:`_FACTOR_CLIP`.
+    """
+
+    feature_names: list
+    kinds: list  #: strategy one-hot vocabulary seen at fit time
+    weights: "np.ndarray"  #: [1 + features + kinds] — bias first
+    n_rows: int
+    n_programs: int
+
+    @classmethod
+    def fit_rows(cls, rows, l2: float = 1.0) -> "CalibrationModel | None":
+        """Fit from ``[(fingerprint, features_dict, ratio)]`` rows (the
+        shape ``core.autoshard.model_rows`` yields).  Returns ``None``
+        for degenerate inputs (no rows / no positive ratios)."""
+        import numpy as np
+
+        rows = [
+            (fp, f, r) for fp, f, r in rows
+            if isinstance(f, dict) and r and r > 0
+        ]
+        if not rows:
+            return None
+        names = sorted({
+            k for _fp, f, _r in rows
+            for k, v in f.items()
+            if isinstance(v, (int, float))
+        })
+        kinds = sorted({f.get("kind") for _fp, f, _r in rows} - {None})
+        xs, ys = [], []
+        for _fp, f, r in rows:
+            xs.append(cls._vector(f, names, kinds))
+            ys.append(np.log(r))
+        x = np.asarray(xs, np.float64)
+        y = np.asarray(ys, np.float64)
+        reg = l2 * np.eye(x.shape[1])
+        reg[0, 0] = 0.0  # the bias absorbs the global mean unpenalized
+        w = np.linalg.solve(x.T @ x + reg, x.T @ y)
+        return cls(
+            feature_names=names,
+            kinds=kinds,
+            weights=w,
+            n_rows=len(rows),
+            n_programs=len({fp for fp, _f, _r in rows}),
+        )
+
+    @staticmethod
+    def _vector(features: dict, names, kinds):
+        import numpy as np
+
+        v = [1.0]
+        v.extend(float(features.get(k, 0.0) or 0.0) for k in names)
+        kind = features.get("kind")
+        v.extend(1.0 if kind == k else 0.0 for k in kinds)
+        return np.asarray(v, np.float64)
+
+    def predict_factor(self, features: dict) -> float:
+        """The calibration factor (measured/prior ratio) this model
+        predicts for one candidate's feature vector, clipped to
+        ``[1/32, 32]``."""
+        import numpy as np
+
+        pred = float(
+            self._vector(features, self.feature_names, self.kinds)
+            @ self.weights
+        )
+        lim = float(np.log(_FACTOR_CLIP))
+        return float(np.exp(np.clip(pred, -lim, lim)))
+
+    def record(self) -> dict:
+        return {
+            "n_rows": self.n_rows,
+            "n_programs": self.n_programs,
+            "features": list(self.feature_names),
+            "kinds": list(self.kinds),
+        }
+
+
 # -- the snapshot advisor -----------------------------------------------------
 
 #: env var: assumed snapshot-disk sequential bandwidth (GB/s) used by the

@@ -11,6 +11,7 @@ single controller and device arrays persist in HBM between stages.
 from __future__ import annotations
 
 import contextlib
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +56,21 @@ def mesh_desc(mesh: Mesh) -> str:
     return f"{mesh.shape[DATA_AXIS]}x{mesh.shape[MODEL_AXIS]}"
 
 
+@functools.lru_cache(maxsize=None)
+def _mesh_shapes(n_devices: int) -> tuple[tuple[int, int], ...]:
+    """Memoized factorization body of :func:`enumerate_mesh_shapes` — the
+    device count never changes within a process, yet the placement search
+    re-enumerates on every ``fit()``; computing the divisor walk once per
+    count keeps that recurring call a dict hit."""
+    if n_devices < 1:
+        raise ValueError(f"need >= 1 device, got {n_devices}")
+    return tuple(
+        (d, n_devices // d)
+        for d in range(n_devices, 0, -1)
+        if n_devices % d == 0
+    )
+
+
 def enumerate_mesh_shapes(n_devices: int) -> list[tuple[int, int]]:
     """Every (data, model) factorization of ``n_devices``, data-major
     descending — the candidate set the placement search (core.autoshard)
@@ -62,26 +78,33 @@ def enumerate_mesh_shapes(n_devices: int) -> list[tuple[int, int]]:
     participate in every candidate (a smaller mesh never beats a larger one
     on the cost model's axes, and the single-device strategies are their
     own candidates); ``n_devices=1`` is the one-shape list ``[(1, 1)]``,
-    and a prime count yields exactly its two degenerate factorizations."""
-    if n_devices < 1:
-        raise ValueError(f"need >= 1 device, got {n_devices}")
-    return [
-        (d, n_devices // d)
-        for d in range(n_devices, 0, -1)
-        if n_devices % d == 0
-    ]
+    and a prime count yields exactly its two degenerate factorizations.
+    Memoized per device count (a fresh list is returned per call; the
+    cached tuple is never handed out mutable)."""
+    return list(_mesh_shapes(n_devices))
+
+
+#: device tuple -> materialized candidate meshes; a Mesh wraps the device
+#: objects themselves, so caching on the exact device identity (same
+#: devices, same order) is both safe and the determinism contract.
+_mesh_cache: dict[tuple, tuple[Mesh, ...]] = {}
 
 
 def enumerate_meshes(devices) -> list[Mesh]:
     """:func:`enumerate_mesh_shapes` materialized over a fixed device
     list — the same devices in the same order for every candidate, so two
     searches over one device set enumerate identical meshes (searched-plan
-    determinism)."""
-    devices = list(devices)
-    return [
-        make_mesh(data=d, model=m, devices=devices)
-        for d, m in enumerate_mesh_shapes(len(devices))
-    ]
+    determinism).  Memoized per device tuple: every ``fit()`` under a mesh
+    re-enumerates candidates, and each uncached enumeration costs one jax
+    ``Mesh`` construction per factorization."""
+    key = tuple(devices)
+    cached = _mesh_cache.get(key)
+    if cached is None:
+        cached = _mesh_cache[key] = tuple(
+            make_mesh(data=d, model=m, devices=list(key))
+            for d, m in _mesh_shapes(len(key))
+        )
+    return list(cached)
 
 
 _current_mesh: list[Mesh] = []

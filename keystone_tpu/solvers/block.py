@@ -140,7 +140,8 @@ jax.tree_util.register_pytree_node(
 )
 
 
-def _fused_bcd_impl(x, labels, lam, nvalid, num_iter: int, widths, mesh):
+def _fused_bcd_impl(x, labels, lam, nvalid, num_iter: int, widths, mesh,
+                    specs=None):
     """The ENTIRE block-least-squares fit as one compiled program.
 
     Centering (label + per-block feature means over the ``nvalid`` true
@@ -167,7 +168,14 @@ def _fused_bcd_impl(x, labels, lam, nvalid, num_iter: int, widths, mesh):
 
     With ``mesh``: rows shard over the data axis (grams lower to local
     MXU gram + ICI all-reduce), models/labels' class columns shard over the
-    model axis.
+    model axis.  ``specs`` (static; a sorted tuple of
+    ``(operand, spec-string)`` pairs from a searched spec assignment —
+    core.autoshard ISSUE 10) overrides the per-operand layout: ``"x"``
+    defaults to ``data@dim0``, ``"labels"`` to the caller's placement,
+    ``"models"`` to ``model@dim2``; each chosen spec lowers through
+    ``autoshard.spec_sharding`` into the very ``NamedSharding`` constraint
+    executed here, so a searched layout is REAL, not just byte accounting.
+    ``specs=None`` is bit-for-bit the PR 9 program.
 
     Returns (models [B, bs, k], label_mean [k], means [B, bs]).
     """
@@ -177,11 +185,23 @@ def _fused_bcd_impl(x, labels, lam, nvalid, num_iter: int, widths, mesh):
     n = labels.shape[0]
 
     col_spec = None
+    mrow_spec = None
     if mesh is not None:
+        sp = dict(specs) if specs else {}
         x = jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(DATA_AXIS, None))
+            x, autoshard.spec_sharding(sp.get("x", "data@dim0"), mesh, 2)
         )
-        col_spec = NamedSharding(mesh, P(None, None, MODEL_AXIS))
+        lspec = sp.get("labels")
+        if lspec is not None:
+            labels = jax.lax.with_sharding_constraint(
+                labels, autoshard.spec_sharding(lspec, mesh, 2)
+            )
+        mspec = sp.get("models", "model@dim2")
+        if mspec == "model@dim2":
+            col_spec = NamedSharding(mesh, P(None, None, MODEL_AXIS))
+            mrow_spec = NamedSharding(mesh, P(None, MODEL_AXIS))
+        elif mspec != "replicated":  # replicated: no constraint at all
+            raise ValueError(f"unsupported models spec {mspec!r}")
 
     mask = (jnp.arange(n) < nvalid).astype(dtype)[:, None]
     nv = jnp.asarray(nvalid, dtype)
@@ -226,10 +246,8 @@ def _fused_bcd_impl(x, labels, lam, nvalid, num_iter: int, widths, mesh):
         r_i = res + a_i @ m_i
         atb = a_i.T @ r_i  # rows contract over the data axis -> one psum
         m_new = jsl.cho_solve((c_i, False), atb)
-        if col_spec is not None:
-            m_new = jax.lax.with_sharding_constraint(
-                m_new, NamedSharding(mesh, P(None, MODEL_AXIS))
-            )
+        if mrow_spec is not None:
+            m_new = jax.lax.with_sharding_constraint(m_new, mrow_spec)
         return r_i - a_i @ m_new, m_new
 
     def epoch(carry, _):
@@ -254,7 +272,7 @@ def _fused_bcd_fit_variant(donate_argnums: tuple = ()):
     never a caller-visible passthrough array (VERDICT r5 weak #1)."""
     return jax.jit(
         _fused_bcd_impl,
-        static_argnames=("num_iter", "widths", "mesh"),
+        static_argnames=("num_iter", "widths", "mesh", "specs"),
         donate_argnums=donate_argnums,
     )
 
@@ -298,16 +316,39 @@ def _execute_fused_bcd(plan, donate_argnums, x, labels, lam, nvalid,
 
 
 def _execute_fused_bcd_mesh(plan, x, labels, lam, nvalid, num_iter: int,
-                            widths, mesh):
-    """Dispatch the GSPMD fused program for one mesh-ladder tier.  The
-    jitted entry — not ``plan.compiled`` — is used deliberately: an AOT
-    executable bakes committed input shardings and scalar placements that a
-    later call's padded inputs need not match exactly, while the jit cache
-    keys on the same (aval, sharding) signature and reuses its own
-    compilation.  Module level so the chaos harness can inject
-    RESOURCE_EXHAUSTED here to drive the mesh ladder's step-down."""
+                            widths, mesh, specs=None):
+    """Dispatch the GSPMD fused program for one mesh-ladder tier (``specs``:
+    the tier's searched per-operand layout assignment, hashable, or None
+    for the default layout).  The jitted entry — not ``plan.compiled`` —
+    is used deliberately: an AOT executable bakes committed input
+    shardings and scalar placements that a later call's padded inputs need
+    not match exactly, while the jit cache keys on the same
+    (aval, sharding) signature and reuses its own compilation.  Module
+    level so the chaos harness can inject RESOURCE_EXHAUSTED here to drive
+    the mesh ladder's step-down (the ``spec_mispredict`` family kills the
+    top-ranked spec-sharded plan at this very dispatch)."""
     del plan
-    return _fused_bcd_fit(x, labels, lam, nvalid, num_iter, widths, mesh)
+    return _fused_bcd_fit(x, labels, lam, nvalid, num_iter, widths, mesh,
+                          specs)
+
+
+def _bcd_spec_variants(m) -> list[dict]:
+    """Per-operand spec assignments the BCD placement search enumerates
+    for one mesh shape, beyond the strategy's default layout (row-sharded
+    inputs, model-axis-sharded model columns): model-axis-sharded label
+    columns (the wide-class layout), fully-replicated model blocks, and
+    fully-replicated small operands.  Every entry is legal by
+    construction — the class axis is padded to a model-axis multiple
+    before execution — and deterministic, so two searches over one device
+    set enumerate identical candidates."""
+    d_sz, m_sz = m.shape[DATA_AXIS], m.shape[MODEL_AXIS]
+    out: list[dict] = []
+    if m_sz > 1:
+        out.append({"labels": "model@dim1"})
+        out.append({"models": "replicated"})
+    if d_sz * m_sz > 1:
+        out.append({"labels": "replicated", "models": "replicated"})
+    return out
 
 
 def _blocked_design_matrix(features, block_size: int, num_features=None):
@@ -868,37 +909,68 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
         itx = np.dtype(xdt).itemsize
 
-        def mesh_tier(m, prior_rank, hand):
+        def mesh_tier(m, prior_rank, hand, specs=None):
+            """One fused-mesh candidate: ``specs=None`` is the strategy's
+            default layout (row-sharded inputs, model-axis-sharded model
+            columns — the PR 9 hand rung, bit-for-bit); a spec assignment
+            makes the candidate EXECUTE that per-operand layout, with the
+            hints charging the chosen specs' actual per-chip bytes instead
+            of the best-spec lower bound."""
             name = f"fused[mesh {mesh_desc(m)}]"
+            if specs:
+                name = f"fused[mesh {mesh_desc(m)}|{autoshard.spec_tag(specs)}]"
             d_sz, m_sz = m.shape[DATA_AXIS], m.shape[MODEL_AXIS]
             n_pad = n0 + (-n0) % d_sz
             k_pad = k + (-k) % m_sz
+            mdict = dict(m.shape)
+            lspec = (specs or {}).get("labels", "data@dim0")
+            mspec = (specs or {}).get("models", "model@dim2")
+            # The residual carries inherit the labels layout; the models
+            # carry follows the models spec.  One byte helper feeds the
+            # transient floor, the prune figure, and the cost model alike.
+            res_b = autoshard.spec_chip_bytes(
+                (n_pad, k_pad), dtype, lspec, mdict
+            )
+            models_b = autoshard.spec_chip_bytes(
+                (nb, bs, k_pad), dtype,
+                "model@dim2" if mspec == "model@dim2" else "replicated",
+                mdict,
+            )
             # Analytic per-chip transient floor (CPU backends report
             # temp 0): one centered row-sharded block, the replicated
-            # Cholesky stack, two residual carries, the model-axis-
-            # sharded models carry.  Also the cost model's temp term and
-            # the zero-cost prune's byte figure — one formula, three uses.
-            floor = it * (
-                n_pad * bs // d_sz
-                + nb * bs * bs
-                + 2 * n_pad * k_pad // d_sz
-                + nb * bs * k_pad // m_sz
+            # Cholesky stack, two residual carries, the models carry.
+            floor = (
+                it * (n_pad * bs // d_sz + nb * bs * bs)
+                + 2 * res_b + models_b
             )
-            hints = {
-                # Per-operand bytes from the program's AVALS through the
-                # spec enumeration (data/model/replicated over divisible
-                # dims, minimum per-chip bytes) — the best sharding this
-                # mesh shape can achieve, a lower bound of any layout the
-                # compiled admission will charge.
-                "arg_bytes": sum(
-                    autoshard.best_spec(a, dict(m.shape))["per_chip_bytes"]
+            if specs:
+                # A spec candidate charges the bytes of the layout it
+                # will actually execute — the spec dimension is real.
+                arg_bytes = (
+                    autoshard.spec_chip_bytes(
+                        (n_pad, nb * bs), xdt,
+                        (specs or {}).get("x", "data@dim0"), mdict,
+                    )
+                    + autoshard.spec_chip_bytes(
+                        (n_pad, k_pad), dtype, lspec, mdict
+                    )
+                )
+            else:
+                # Hand accounting: per-operand bytes through the spec
+                # enumeration's minimum (the best sharding this mesh shape
+                # can achieve) — a lower bound of any layout the compiled
+                # admission will charge.
+                arg_bytes = sum(
+                    autoshard.best_spec(a, mdict)["per_chip_bytes"]
                     for a in (
                         jax.ShapeDtypeStruct((n_pad, nb * bs), xdt),
                         jax.ShapeDtypeStruct((n_pad, k_pad), dtype),
                     )
-                ),
+                )
+            hints = {
+                "arg_bytes": arg_bytes,
                 "temp_bytes": floor,
-                "out_bytes": it * (nb * bs * k_pad // m_sz + k_pad + nb * bs),
+                "out_bytes": it * (k_pad + nb * bs) + models_b,
                 "flops": (
                     2.0 * n_pad * bs * bs * nb
                     + self.num_iter * 4.0 * n_pad * bs * k_pad * nb
@@ -910,42 +982,75 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                     if d_sz > 1 else 0
                 ),
             }
+            spec_t = tuple(sorted(specs.items())) if specs else None
 
             def plan():
                 budget, _worst = kmem.min_chip_budget(m)
                 sds = jax.ShapeDtypeStruct
                 row = row_sharding(m)
                 x_s = sds((n_pad, nb * bs), xdt, sharding=row)
-                y_s = sds((n_pad, k_pad), dtype, sharding=row)
+                y_s = sds(
+                    (n_pad, k_pad), dtype,
+                    sharding=(
+                        row if lspec == "data@dim0"
+                        else autoshard.spec_sharding(lspec, m, 2)
+                    ),
+                )
                 lam_s, i32_s = sds((), dtype), sds((), jnp.int32)
                 return kmem.plan_program(
                     _fused_bcd_fit, x_s, y_s, lam_s, i32_s,
-                    self.num_iter, widths, m,
+                    self.num_iter, widths, m, spec_t,
                     label=f"bcd_{name}", budget=budget,
                     min_temp_bytes=floor, mesh=m,
                 )
 
             def run(plan):
                 report.mesh_shape = dict(m.shape)
-                (x_p, y_p), nv = pad_shard_inputs(m, nvalid0, x, labels)
-                # Class columns shard over the model axis; zero label
-                # columns stay zero through every BCD update — exact pad.
-                col_pad = (-int(jnp.shape(y_p)[1])) % m_sz
-                if col_pad:
-                    y_p = jnp.pad(y_p, ((0, 0), (0, col_pad)))
-                nv = nv if nv is not None else int(jnp.shape(y_p)[0])
+                if spec_t is None or lspec == "data@dim0":
+                    (x_p, y_p), nv = pad_shard_inputs(m, nvalid0, x, labels)
+                    # Class columns shard over the model axis; zero label
+                    # columns stay zero through every BCD update — exact
+                    # pad.
+                    col_pad = (-int(jnp.shape(y_p)[1])) % m_sz
+                    if col_pad:
+                        y_p = jnp.pad(y_p, ((0, 0), (0, col_pad)))
+                    nv = nv if nv is not None else int(jnp.shape(y_p)[0])
+                else:
+                    # Non-default labels layout: pad rows to the sharded
+                    # design matrix's count and columns to a model-axis
+                    # multiple, then PLACE per the chosen spec — the
+                    # program's constraint and this placement read the
+                    # same spec string, so they cannot drift.
+                    (x_p,), nv = pad_shard_inputs(m, nvalid0, x)
+                    nv = nv if nv is not None else n0
+                    row_pad = int(jnp.shape(x_p)[0]) - n0
+                    col_pad = (-k) % m_sz
+                    if isinstance(labels, jax.Array):
+                        y_p = (
+                            jnp.pad(labels, ((0, row_pad), (0, col_pad)))
+                            if row_pad or col_pad else labels
+                        )
+                    else:
+                        y_p = np.pad(
+                            np.asarray(labels),
+                            ((0, row_pad), (0, col_pad)),
+                        )
+                    y_p = jax.device_put(
+                        jnp.asarray(y_p), autoshard.spec_sharding(lspec, m, 2)
+                    )
                 models, label_mean, means = _execute_fused_bcd_mesh(
                     plan, jnp.asarray(x_p), jnp.asarray(y_p), lam_arr,
-                    nv, self.num_iter, widths, m,
+                    nv, self.num_iter, widths, m, spec_t,
                 )
-                if col_pad:
+                if k_pad != k:
                     models = models[:, :, :k]
                     label_mean = label_mean[:k]
                 return models, label_mean, means
 
             return autoshard.Candidate(
                 name, "fused_mesh", plan, run, hints=hints,
-                mesh_axes=dict(m.shape), prior_rank=prior_rank, hand=hand,
+                mesh_axes=mdict, prior_rank=prior_rank, hand=hand,
+                specs=dict(specs) if specs else None,
             )
 
         def plan_single():
@@ -986,7 +1091,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         if rm is not None:
             cands.append(mesh_tier(rm, 1, True))
         # The searched candidate set: every remaining (data, model)
-        # factorization of the SAME devices, ranked by the cost model but
+        # factorization of the SAME devices, then (KEYSTONE_AUTOSHARD_SPECS)
+        # the per-operand SPEC assignments of every mesh shape — e.g.
+        # model-axis-sharded label columns, or fully-replicated model
+        # blocks — each an executable layout, ranked by the cost model but
         # never promoted past the hand rungs on an untrained prior.  Only
         # enumerated when the search will run — a hand-ladder walk would
         # discard them, and each costs a jax Mesh construction.
@@ -994,9 +1102,17 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             hand_shapes = {
                 mesh_desc(c_mesh) for c_mesh in (mesh, rm) if c_mesh
             }
+            searched_meshes = [mesh] + ([rm] if rm is not None else [])
             for extra in enumerate_meshes(list(mesh.devices.flat)):
                 if mesh_desc(extra) not in hand_shapes:
+                    searched_meshes.append(extra)
                     cands.append(mesh_tier(extra, len(cands), False))
+            if autoshard.specs_enabled():
+                for sm in searched_meshes:
+                    for sp in _bcd_spec_variants(sm):
+                        cands.append(
+                            mesh_tier(sm, len(cands), False, specs=sp)
+                        )
         cands.append(autoshard.Candidate(
             "single_device", "single_device", plan_single, run_single,
             hints={

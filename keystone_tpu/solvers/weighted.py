@@ -61,6 +61,22 @@ from ..parallel.mesh import (
 from .block import BlockLinearMapper, _blocked_design_matrix, _design_matrix_owned
 
 
+def _bwls_spec_variants(m, n_classes: int) -> list[dict]:
+    """Per-operand spec assignments the BWLS placement search enumerates
+    for one mesh shape beyond the default layout: model-axis-sharded
+    label/residual columns (the wide-class layout — per-class residual
+    columns are independent, so class columns shard cleanly over the
+    model axis when the class count divides it) and fully-replicated
+    labels.  Deterministic, and legal by construction."""
+    d_sz, m_sz = m.shape[DATA_AXIS], m.shape[MODEL_AXIS]
+    out: list[dict] = []
+    if m_sz > 1 and n_classes % m_sz == 0:
+        out.append({"labels": "model@dim1"})
+    if d_sz * m_sz > 1:
+        out.append({"labels": "replicated"})
+    return out
+
+
 @dataclasses.dataclass
 class _SolveCtx:
     """Mesh-dependent BWLS solve layout for ONE ladder tier: the padded
@@ -270,6 +286,7 @@ def _fused_bwls_impl(
     x, labels_sorted, valid, seg_ids, starts, counts, counts_f,
     joint_label_mean, nvalid, lam, w,
     num_iter: int, n_max: int, chunk: int, num_classes: int, widths, mesh,
+    specs=None,
 ):
     """The ENTIRE BWLS solve as one compiled program (the
     BlockLeastSquares treatment, solvers/block._fused_bcd_fit): residual
@@ -290,6 +307,12 @@ def _fused_bwls_impl(
     equations), so their solutions are exactly zero and every batched solve
     stays nonsingular even at lam=0.
 
+    ``specs`` (static; sorted tuple of ``(operand, spec)`` pairs from a
+    searched spec assignment, core.autoshard ISSUE 10): overrides the
+    per-operand layout — ``"x"`` defaults to ``data@dim0``, ``"labels"``
+    (the sorted labels, and through them the residual carries) to the
+    caller's placement.  ``specs=None`` is bit-for-bit the PR 9 program.
+
     Returns (models [B, bs, C], intercept [C]).
     """
     bs = max(widths)
@@ -298,9 +321,15 @@ def _fused_bwls_impl(
     n = nvalid.astype(dtype)
 
     if mesh is not None:
+        sp = dict(specs) if specs else {}
         x = jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(DATA_AXIS, None))
+            x, autoshard.spec_sharding(sp.get("x", "data@dim0"), mesh, 2)
         )
+        lspec = sp.get("labels")
+        if lspec is not None:
+            labels_sorted = jax.lax.with_sharding_constraint(
+                labels_sorted, autoshard.spec_sharding(lspec, mesh, 2)
+            )
 
     res = (labels_sorted - joint_label_mean) * valid
     rmean = _residual_class_means(res, seg_ids, counts_f, num_classes)
@@ -362,7 +391,9 @@ def _fused_bwls_impl(
     return models, intercept
 
 
-_BWLS_STATICS = ("num_iter", "n_max", "chunk", "num_classes", "widths", "mesh")
+_BWLS_STATICS = (
+    "num_iter", "n_max", "chunk", "num_classes", "widths", "mesh", "specs",
+)
 
 
 @functools.lru_cache(maxsize=None)
@@ -816,41 +847,67 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         itx = np.dtype(xdt).itemsize
 
-        def mesh_tier(m, prior_rank, hand):
+        def mesh_tier(m, prior_rank, hand, specs=None):
+            """One fused-mesh BWLS candidate: ``specs=None`` is the
+            default layout (the PR 9 hand rung, bit-for-bit); a spec
+            assignment EXECUTES that per-operand layout — e.g.
+            model-axis-sharded label columns for wide-class solves — with
+            the hints charging the chosen specs' actual per-chip bytes."""
             name = f"fused[mesh {mesh_desc(m)}]"
+            if specs:
+                name = f"fused[mesh {mesh_desc(m)}|{autoshard.spec_tag(specs)}]"
             d_sz, m_sz = m.shape[DATA_AXIS], m.shape[MODEL_AXIS]
+            mdict = dict(m.shape)
+            lspec = (specs or {}).get("labels", "data@dim0")
             # The tier's padded layout, computed WITHOUT building the ctx
             # (the search scores every enumerated mesh shape; the O(p_tot)
             # gather/seg/mask buffers stay lazy below).
             p_tot_a = n + n_max + ((-(n + n_max)) % d_sz)
             chunk_a = max(1, min(self.class_chunk, n_classes))
             chunk_a = -(-chunk_a // m_sz) * m_sz
+            # Residual carries inherit the labels layout (default: row
+            # sharded over the data axis).
+            res_b = autoshard.spec_chip_bytes(
+                (p_tot_a, n_classes), dtype, lspec, mdict
+            )
             # Analytic per-chip transient floor (CPU backends report
-            # temp 0): two row-sharded residual carries, one row-sharded
-            # block slice, the model-axis-sharded class-solve slab, the
-            # replicated stats/models stacks.  Also the cost model's temp
-            # term and the zero-cost prune's figure — one formula.
-            floor = it * (
-                2 * p_tot_a * n_classes // d_sz
-                + p_tot_a * bs // d_sz
+            # temp 0): two residual carries, one row-sharded block slice,
+            # the model-axis-sharded class-solve slab, the replicated
+            # stats/models stacks.  Also the cost model's temp term and
+            # the zero-cost prune's figure — one formula.
+            floor = 2 * res_b + it * (
+                p_tot_a * bs // d_sz
                 + chunk_a * n_max * bs // m_sz
                 + nb * (bs * bs + bs + n_classes * bs)
                 + nb * bs * n_classes
             )
-            hints = {
-                # Per-operand bytes from the program's AVALS through the
-                # spec enumeration (minimum per-chip bytes over the legal
-                # data/model/replicated shardings of each dim) — a lower
-                # bound of any layout the compiled admission will charge;
-                # the valid/seg vectors the program truly replicates are
-                # charged replicated.
-                "arg_bytes": sum(
-                    autoshard.best_spec(a, dict(m.shape))["per_chip_bytes"]
+            if specs:
+                # A spec candidate charges the layout it will execute.
+                arg_bytes = (
+                    autoshard.spec_chip_bytes(
+                        (p_tot_a, d_tot), xdt,
+                        (specs or {}).get("x", "data@dim0"), mdict,
+                    )
+                    + autoshard.spec_chip_bytes(
+                        (p_tot_a, n_classes), dtype, lspec, mdict
+                    )
+                    + it * p_tot_a  # replicated valid/seg vectors
+                )
+            else:
+                # Hand accounting: per-operand bytes through the spec
+                # enumeration's minimum (the best sharding this mesh
+                # shape can achieve) — a lower bound of any layout the
+                # compiled admission will charge; the valid/seg vectors
+                # the program truly replicates are charged replicated.
+                arg_bytes = sum(
+                    autoshard.best_spec(a, mdict)["per_chip_bytes"]
                     for a in (
                         jax.ShapeDtypeStruct((p_tot_a, d_tot), xdt),
                         jax.ShapeDtypeStruct((p_tot_a, n_classes), dtype),
                     )
-                ) + it * p_tot_a,  # replicated valid/seg vectors
+                ) + it * p_tot_a
+            hints = {
+                "arg_bytes": arg_bytes,
                 "temp_bytes": floor,
                 "out_bytes": it * (nb * bs * n_classes + n_classes),
                 "flops": (
@@ -867,6 +924,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     if d_sz > 1 else 0
                 ),
             }
+            spec_t = tuple(sorted(specs.items())) if specs else None
             # Lazy, memoized: a tier's O(p_tot) gather/seg/mask buffers are
             # only built once the ladder actually CONSIDERS the tier (the
             # common admitted-first-tier fit never pays for the rungs
@@ -885,7 +943,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 i32 = jnp.int32
                 row = NamedSharding(m, P(DATA_AXIS, None))
                 x_s = sds((ctx_.p_tot, d_tot), xdt, sharding=row)
-                y_s = sds((ctx_.p_tot, n_classes), dtype, sharding=row)
+                y_s = sds(
+                    (ctx_.p_tot, n_classes), dtype,
+                    sharding=(
+                        row if lspec == "data@dim0"
+                        else autoshard.spec_sharding(lspec, m, 2)
+                    ),
+                )
                 # valid/seg/stat vectors are replicated — charged whole.
                 v_s = sds((ctx_.p_tot, 1), dtype)
                 seg_s = sds((ctx_.p_tot,), i32)
@@ -895,7 +959,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     _fused_bwls_fit_variant((0, 1)),
                     x_s, y_s, v_s, seg_s, c_i32, c_i32, c_f, c_f, nv_s,
                     sc_s, sc_s, self.num_iter, n_max, ctx_.chunk, n_classes,
-                    widths, m,
+                    widths, m, spec_t,
                     label=f"bwls_{name}", budget=budget,
                     min_temp_bytes=floor, mesh=m,
                 )
@@ -903,15 +967,23 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             def run(plan):
                 ctx_ = ctx()
                 report.mesh_shape = dict(m.shape)
+                ls = ctx_.sort_labels()
+                if lspec != "data@dim0":
+                    # The searched labels layout, placed for real — the
+                    # program's constraint reads the same spec string.
+                    ls = jax.device_put(
+                        ls, autoshard.spec_sharding(lspec, m, 2)
+                    )
                 args = (
-                    ctx_.sort_pad(x), ctx_.sort_labels(), ctx_.valid_d,
+                    ctx_.sort_pad(x), ls, ctx_.valid_d,
                     ctx_.seg_ids, ctx_.starts, ctx_.counts, ctx_.counts_f,
                     ctx_.joint_label_mean, jnp.asarray(n),
                     jnp.asarray(self.lam, dtype),
                     jnp.asarray(self.mixture_weight, dtype),
                 )
                 statics = (
-                    self.num_iter, n_max, ctx_.chunk, n_classes, widths, m
+                    self.num_iter, n_max, ctx_.chunk, n_classes, widths, m,
+                    spec_t,
                 )
                 # plan=None: the jitted sharded program, not the AOT plan
                 # executable (committed-sharding pitfalls — see
@@ -920,7 +992,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
             return autoshard.Candidate(
                 name, "fused_mesh", plan, run, hints=hints,
-                mesh_axes=dict(m.shape), prior_rank=prior_rank, hand=hand,
+                mesh_axes=mdict, prior_rank=prior_rank, hand=hand,
+                specs=dict(specs) if specs else None,
             )
 
         def plan_single():
@@ -961,17 +1034,27 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         if rm is not None:
             cands.append(mesh_tier(rm, 1, True))
         # Searched candidate set: the remaining (data, model)
-        # factorizations of the same devices, ranked after the hand rungs
-        # on an untrained prior.  Only enumerated when the search will
-        # run — a hand-ladder walk would discard them, and each costs a
-        # jax Mesh construction.
+        # factorizations of the same devices, then the per-operand SPEC
+        # assignments of every mesh shape (KEYSTONE_AUTOSHARD_SPECS) —
+        # model-axis-sharded label columns for wide-class solves, or fully
+        # replicated labels — ranked after the hand rungs on an untrained
+        # prior.  Only enumerated when the search will run — a hand-ladder
+        # walk would discard them, and each costs a jax Mesh construction.
         if autoshard.will_search(plan_arg):
             hand_shapes = {
                 mesh_desc(c_mesh) for c_mesh in (mesh, rm) if c_mesh
             }
+            searched_meshes = [mesh] + ([rm] if rm is not None else [])
             for extra in enumerate_meshes(list(mesh.devices.flat)):
                 if mesh_desc(extra) not in hand_shapes:
+                    searched_meshes.append(extra)
                     cands.append(mesh_tier(extra, len(cands), False))
+            if autoshard.specs_enabled():
+                for sm in searched_meshes:
+                    for sp in _bwls_spec_variants(sm, n_classes):
+                        cands.append(
+                            mesh_tier(sm, len(cands), False, specs=sp)
+                        )
         p_tot_s = n + n_max
         cands.append(autoshard.Candidate(
             "single_device", "single_device", plan_single, run_single,
@@ -1024,7 +1107,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         lam_arr = jnp.asarray(self.lam, dtype)
         w_arr = jnp.asarray(self.mixture_weight, dtype)
         nv_arr = jnp.asarray(n, jnp.int32)
-        statics = (self.num_iter, n_max, chunk, n_classes, widths, None)
+        statics = (self.num_iter, n_max, chunk, n_classes, widths, None, None)
 
         sds = jax.ShapeDtypeStruct
         i32 = jnp.int32

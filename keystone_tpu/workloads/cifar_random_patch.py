@@ -102,6 +102,11 @@ class RandomCifarConfig:
     #: ``KEYSTONE_AUTOSHARD``); the searched table lands in
     #: ``results["placement"]`` whenever a search ran.
     auto_shard: bool = False
+    #: Placement override forwarded verbatim to ``fit(plan=...)`` —
+    #: ``False`` hand ladder, ``True`` force search, a PlacementPlan or
+    #: candidate-name list replays/forces a ranking (the chaos harness
+    #: forces a SPEC-assignment plan to the top through this).
+    solve_plan: object = None
     #: Closed-loop ingest autotuner on the ``--streamTestTar`` path: retune
     #: decode width / ring depth / decode-ahead mid-stream from live stall
     #: metrics (results carry the knob trajectory).
@@ -434,7 +439,10 @@ def run(
             labels,
             checkpoint=conf.solve_checkpoint,
             resume_from=conf.solve_resume,
-            plan=True if conf.auto_shard else None,
+            plan=(
+                conf.solve_plan if conf.solve_plan is not None
+                else (True if conf.auto_shard else None)
+            ),
         )
         log_fit_report(solver, label="cifar random-patch solve")
         if numerics_guard_enabled():

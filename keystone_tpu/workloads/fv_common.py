@@ -52,6 +52,113 @@ def grayscale(batch) -> jnp.ndarray:
     return GrayScaler()(PixelScaler()(jnp.asarray(batch)))[..., 0]
 
 
+def searched_bucket_featurize(label: str, images: list, per_batch, mesh,
+                              *, plan=None):
+    """Eager bucket featurize with the PLACEMENT chosen by the same
+    cost-model-ranked search the solvers use (core.autoshard, ISSUE 10) —
+    the hand-written ``shard_batch(batch, mesh)`` layout stops being the
+    only option and becomes the prior head of a ranked candidate list:
+
+    * ``row_sharded[mesh DxM]`` for the given mesh (the hand placement,
+      rank 0 on an untrained model — bit-identical default), and for
+      every other (data, model) factorization of the same devices;
+    * the ``single_device`` floor (plain ``device_put``), pinned last.
+
+    The chosen candidate runs the WHOLE bucket featurize through the
+    unchanged ``run_ladder`` contract, so a sharded featurize that dies
+    RESOURCE_EXHAUSTED at runtime steps down the ranking counted
+    (``autoshard_stepdown``) instead of killing the workload, and the
+    measured outcome trains the cross-program calibration like any solve
+    plan.  Returns ``(buckets, placement_record_or_None)`` — the record
+    lands next to the solver's in ``results["placement"]``, so featurize
+    and solve placements are chosen by one ranking machinery and audited
+    in one table.  ``mesh=None`` (or a disabled search) is the plain
+    hand path."""
+    from ..core import autoshard
+    from ..core import memory as kmem
+    from ..parallel.mesh import DATA_AXIS, enumerate_meshes, mesh_desc
+
+    raw = bucket_by_shape(images)
+
+    def featurize_with(m):
+        return {
+            shape: (idx, per_batch(shard_batch(batch, m)))
+            for shape, (idx, batch) in raw.items()
+        }
+
+    if mesh is None or not autoshard.will_search(plan):
+        return featurize_with(mesh), None
+
+    total_bytes = sum(int(b.nbytes) for _i, b in raw.values())
+    # The featurize consumes uint8 pixels but computes in float32 — the
+    # roofline prior charges the device-resident working set.
+    f32_bytes = total_bytes * 4
+
+    def tier(m, prior_rank, hand):
+        d_sz = m.shape[DATA_AXIS]
+
+        def run(_mplan, m=m):
+            return featurize_with(m)
+
+        return autoshard.Candidate(
+            f"row_sharded[mesh {mesh_desc(m)}]",
+            "featurize_mesh",
+            plan=lambda m=m, d_sz=d_sz: kmem.plan_bytes(
+                f"{label}:row_sharded[{mesh_desc(m)}]",
+                argument_bytes=total_bytes // d_sz,
+                temp_bytes=f32_bytes // d_sz,
+                mesh=m,
+            ),
+            run=run,
+            hints={
+                "arg_bytes": total_bytes // d_sz,
+                "temp_bytes": f32_bytes // d_sz,
+                "h2d_bytes": total_bytes // d_sz,
+                "dispatches": len(raw),
+            },
+            mesh_axes=dict(m.shape),
+            prior_rank=prior_rank,
+            hand=hand,
+            specs={"batch": "data@dim0"},
+        )
+
+    cands = [tier(mesh, 0, True)]
+    for extra in enumerate_meshes(list(mesh.devices.flat)):
+        if mesh_desc(extra) != mesh_desc(mesh):
+            cands.append(tier(extra, len(cands), False))
+    cands.append(autoshard.Candidate(
+        "single_device",
+        "featurize",
+        plan=lambda: kmem.plan_bytes(
+            f"{label}:single_device",
+            argument_bytes=total_bytes,
+            temp_bytes=f32_bytes,
+        ),
+        run=lambda _mplan: featurize_with(None),
+        hints={
+            "arg_bytes": total_bytes,
+            "temp_bytes": f32_bytes,
+            "h2d_bytes": total_bytes,
+            "dispatches": len(raw),
+        },
+        prior_rank=len(cands),
+        floor=True,
+        specs={"batch": "replicated"},
+    ))
+    report = kmem.FitReport(label=label)
+    out = autoshard.run_search(
+        label, cands, report,
+        fingerprint=autoshard.fingerprint(
+            label,
+            sorted((shape, len(idx)) for shape, (idx, _b) in raw.items()),
+            dict(mesh.shape),
+            autoshard.device_fingerprint(),
+        ),
+        plan=plan,
+    )
+    return out, report.placement
+
+
 def sample_columns(desc_buckets: dict, num_samples: int, seed: int = 42) -> jnp.ndarray:
     """ColumnSampler analog over per-bucket [n, d, cols] descriptor arrays:
     uniform sample of descriptor columns -> [d, <= num_samples].

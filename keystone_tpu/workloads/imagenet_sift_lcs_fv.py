@@ -40,7 +40,6 @@ from ..solvers.pca import BatchPCATransformer, compute_pca
 from ..solvers.weighted import BlockWeightedLeastSquaresEstimator
 from ..utils.stats import get_err_percent
 from .fv_common import (
-    bucket_by_shape,
     collect_autotune,
     fisher_feature_pipeline,
     grayscale,
@@ -48,7 +47,7 @@ from .fv_common import (
     record_stream_autotune,
     sample_columns,
     scatter_features,
-    shard_batch,
+    searched_bucket_featurize,
     stream_config_from_flags,
     stream_descriptor_buckets,
 )
@@ -259,10 +258,14 @@ def _fit_branch(
 
 
 def sift_descriptor_buckets(
-    conf: ImageNetSiftLcsFVConfig, images: list, mesh=None
+    conf: ImageNetSiftLcsFVConfig, images: list, mesh=None,
+    placement_out=None,
 ) -> dict:
     """SIFT branch descriptors (:40-94): SIFT -> BatchSignedHellinger.
-    With a mesh each bucket batch is row-sharded over the data axis."""
+    With a mesh the bucket placement is chosen by the cost-model-ranked
+    search (fv_common.searched_bucket_featurize; the hand row-sharded
+    layout is the untrained head); ``placement_out`` receives the searched
+    record under ``"featurize_sift"``."""
     # bf16 intermediates: measured +35% chain throughput at 99.5%-within-1
     # quantized-descriptor agreement (see SIFTExtractor docstring) — the
     # throughput workload opts in; the op default stays f32.
@@ -274,24 +277,31 @@ def sift_descriptor_buckets(
         return _streaming_buckets(
             images, lambda dev: hell(sift(grayscale(dev)))
         )
-    buckets = {}
-    for shape, (idx, batch) in bucket_by_shape(images).items():
-        gray = grayscale(shard_batch(batch, mesh))
-        buckets[shape] = (idx, hell(sift(gray)))
+    buckets, placement = searched_bucket_featurize(
+        "imagenet_sift_featurize", images,
+        lambda dev: hell(sift(grayscale(dev))), mesh,
+    )
+    if placement_out is not None and placement is not None:
+        placement_out["featurize_sift"] = placement
     return buckets
 
 
 def lcs_descriptor_buckets(
-    conf: ImageNetSiftLcsFVConfig, images: list, mesh=None
+    conf: ImageNetSiftLcsFVConfig, images: list, mesh=None,
+    placement_out=None,
 ) -> dict:
-    """LCS branch descriptors (:96-148): raw LCS straight into PCA."""
+    """LCS branch descriptors (:96-148): raw LCS straight into PCA, with
+    the searched bucket placement under a mesh (record lands in
+    ``placement_out["featurize_lcs"]``)."""
     lcs = LCSExtractor(conf.lcs_stride, conf.lcs_border, conf.lcs_patch)
     if isinstance(images, ImageNetStreamSource):
         return _streaming_buckets(images, lcs)
-    return {
-        shape: (idx, lcs(shard_batch(batch, mesh)))
-        for shape, (idx, batch) in bucket_by_shape(images).items()
-    }
+    buckets, placement = searched_bucket_featurize(
+        "imagenet_lcs_featurize", images, lcs, mesh,
+    )
+    if placement_out is not None and placement is not None:
+        placement_out["featurize_lcs"] = placement
+    return buckets
 
 
 def branch_features(
@@ -303,11 +313,16 @@ def branch_features(
     gmm_files,
     seed: int,
     mesh=None,
+    placement_out=None,
 ):
     """Fit transformers on train, apply to train AND test.  Returns the
     fitted (batch_pca, gmm) too so callers can checkpoint the branch, and
-    the auto-Cacher decision table (None when the pass is off)."""
-    train_desc = descriptor_fn(conf, train_images, mesh)
+    the auto-Cacher decision table (None when the pass is off).
+    ``placement_out``: dict receiving the train pass's searched featurize
+    placement record (see the descriptor functions)."""
+    train_desc = descriptor_fn(
+        conf, train_images, mesh, placement_out=placement_out
+    )
     batch_pca, gmm, train_pca_desc, cache_plan = _fit_branch(
         conf, train_desc, pca_file, gmm_files, seed,
         label=descriptor_fn.__name__.replace("_descriptor_buckets", ""),
@@ -359,6 +374,7 @@ def run(
     t0 = time.perf_counter()
 
     sift_plan = lcs_plan = placement_rec = None
+    feat_placements: dict = {}
     if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
         # Load-or-fit of the whole fitted pipeline: skip training
         # featurization and every fit; score test with restored state.
@@ -387,6 +403,7 @@ def run(
                 (conf.sift_gmm_mean_file, conf.sift_gmm_var_file, conf.sift_gmm_wts_file),
                 conf.seed,
                 mesh,
+                placement_out=feat_placements,
             )
         with stage_timer("lcs_branch"):
             train_lcs, test_lcs, lcs_pca, lcs_gmm, lcs_plan = branch_features(
@@ -398,6 +415,7 @@ def run(
                 (conf.lcs_gmm_mean_file, conf.lcs_gmm_var_file, conf.lcs_gmm_wts_file),
                 conf.seed + 100,
                 mesh,
+                placement_out=feat_placements,
             )
 
         # ZipVectors (:179-183) — kept host-side; the solver shards its blocks
@@ -454,7 +472,11 @@ def run(
         for name, plan in (("sift", sift_plan), ("lcs", lcs_plan)):
             if plan is not None:
                 log.log_info("%s branch %s", name, plan.summary())
-    if placement_rec is not None:
+    if feat_placements:
+        # The searched FEATURIZE placements (per descriptor branch) next
+        # to the solve's — one audit home for every ranked placement.
+        results["placement"] = {"solver": placement_rec, **feat_placements}
+    elif placement_rec is not None:
         # The searched placement table for the weighted block solve —
         # candidates, deny/score rationale, predicted-vs-actual cost.
         results["placement"] = placement_rec

@@ -70,6 +70,11 @@ class MnistRandomFFTConfig:
     #: deny rationale, chosen plan's predicted-vs-actual cost) lands in
     #: ``results["placement"]`` whenever a search ran.
     auto_shard: bool = False
+    #: Placement override forwarded verbatim to ``fit(plan=...)`` —
+    #: ``False`` hand ladder, ``True`` force search, a PlacementPlan or
+    #: candidate-name list replays/forces a ranking (the chaos harness
+    #: forces a SPEC-assignment plan to the top through this).
+    solve_plan: object = None
     #: Whole-fitted-SERVABLE-pipeline checkpoint stem (core.checkpoint):
     #: load-or-fit of ``GroupConcatFeaturizer >> model >> MaxClassifier``
     #: — the artifact the serving endpoint warm-loads.
@@ -190,7 +195,10 @@ def run(
             nvalid=nvalid,
             checkpoint=conf.solve_checkpoint,
             resume_from=conf.solve_resume,
-            plan=True if conf.auto_shard else None,
+            plan=(
+                conf.solve_plan if conf.solve_plan is not None
+                else (True if conf.auto_shard else None)
+            ),
         )
         log_fit_report(solver, label="mnist random-fft solve")
         if numerics_guard_enabled():

@@ -50,7 +50,7 @@ from .fv_common import (
     record_stream_autotune,
     sample_columns,
     scatter_features,
-    shard_batch,
+    searched_bucket_featurize,
     stream_config_from_flags,
     stream_descriptor_buckets,
 )
@@ -164,11 +164,15 @@ class _Log(Logging):
 
 
 def extract_sift_buckets(
-    conf: SIFTFisherConfig, images: list, mesh=None
+    conf: SIFTFisherConfig, images: list, mesh=None, placement_out=None
 ) -> dict:
     """Per shape bucket: grayscale + dense SIFT -> [n, 128, cols].  With a
-    mesh each bucket batch is row-sharded over the data axis so the SIFT
-    program runs data-parallel (pad rows are dropped downstream)."""
+    mesh the PLACEMENT (row-sharded over which factorization, or single
+    device) is chosen by the same cost-model-ranked search as the solve
+    (fv_common.searched_bucket_featurize; the hand row-sharded layout is
+    the untrained head, pad rows are dropped downstream).  A caller-passed
+    ``placement_out`` dict receives the searched record under
+    ``"featurize"``."""
     # bf16 intermediates, the measured-throughput configuration; VOC
     # leave-2-out CV (tools/voc_leave2out_cv.py, mean MAP 0.85) validated
     # the accuracy surrogate under this dtype.  Op default stays f32.
@@ -214,10 +218,11 @@ def extract_sift_buckets(
         src.record_names(names)
         record_stream_autotune(src, st)
         return buckets
-    out = {}
-    for shape, (idx, batch) in bucket_by_shape(images).items():
-        gray = grayscale(shard_batch(batch, mesh))
-        out[shape] = (idx, sift(gray))
+    out, placement = searched_bucket_featurize(
+        "voc_sift_featurize", images, lambda dev: sift(grayscale(dev)), mesh
+    )
+    if placement_out is not None and placement is not None:
+        placement_out["featurize"] = placement
     return out
 
 
@@ -237,6 +242,7 @@ def run(
 
     feat_dim = 2 * conf.desc_dim * conf.vocab_size
     results_cache_plan = results_placement = None
+    feat_placements: dict = {}
 
     # Load-or-fit of the WHOLE fitted pipeline (SURVEY §5 generalized): when
     # the checkpoint exists, training featurization and all fits are skipped
@@ -251,7 +257,9 @@ def run(
         # Runs BEFORE the label node: a streaming source only knows its
         # image order (and therefore labels) after the descriptor pass.
         with stage_timer("sift"):
-            train_desc = extract_sift_buckets(conf, train.images, mesh)
+            train_desc = extract_sift_buckets(
+                conf, train.images, mesh, placement_out=feat_placements
+            )
 
         label_node = ClassLabelIndicatorsFromIntArrayLabels(VOC_NUM_CLASSES)
         train_labels = label_node(train.labels)
@@ -370,10 +378,17 @@ def run(
     }
     if results_cache_plan is not None:
         results["cache_plan"] = results_cache_plan
-    if results_placement is not None:
-        # The searched placement table for the block solve — candidates,
-        # deny/score rationale, chosen plan's predicted-vs-actual cost.
-        results["placement"] = results_placement
+    if results_placement is not None or feat_placements:
+        # The searched placement tables — the block solve's candidates,
+        # deny/score rationale, chosen plan's predicted-vs-actual cost,
+        # and (under a mesh) the searched FEATURIZE placement: one audit
+        # home for every ranked placement decision the run made.
+        if feat_placements:
+            results["placement"] = {
+                "solver": results_placement, **feat_placements
+            }
+        else:
+            results["placement"] = results_placement
     autotune = collect_autotune(train, test)
     if autotune:
         results["autotune"] = autotune

@@ -70,6 +70,13 @@ draws its parameters — fully deterministic):
   searched ranking (``results["placement"]`` proves the order), count an
   ``autoshard_stepdown``, and land predictions bit-equal to the
   fault-free fit — a wrong cost model degrades loudly, never silently.
+* ``spec_mispredict`` — the SPEC-sharded analog (ISSUE 10): the workload
+  runs under a mesh, so the search's top-ranked plan is a real
+  ``NamedSharding``-layout (spec-executing) mesh plan; injected
+  RESOURCE_EXHAUSTED at its GSPMD dispatch forces a counted
+  ``autoshard_stepdown`` to the next-ranked plan, and predictions must
+  stay bit-equal to the fault-free MESH run — a mispredicted sharded
+  layout degrades loudly, never silently.
 """
 
 from __future__ import annotations
@@ -127,6 +134,7 @@ FAMILIES = (
     "malformed_request",
     "serve_burst_oom",
     "plan_mispredict",
+    "spec_mispredict",
 )
 
 #: The serving-path families (core.serve), selectable via
@@ -135,8 +143,8 @@ SERVE_FAMILIES = ("slow_client", "malformed_request", "serve_burst_oom")
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(16))
-FULL_SEEDS = tuple(range(32))
+TIER1_SEEDS = tuple(range(17))
+FULL_SEEDS = tuple(range(34))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -273,6 +281,8 @@ def make_schedule(seed: int) -> Fault:
         )
     if kind == "plan_mispredict":
         return Fault(kind, {"failures": 1})
+    if kind == "spec_mispredict":
+        return Fault(kind, {"failures": 1})
     return Fault("deadline", {"seconds": 1.0})
 
 
@@ -297,7 +307,7 @@ def _mnist_case():
 _mnist_data_cache: list = []
 
 
-def _run_mnist(train_override=None, **conf_kw):
+def _run_mnist(train_override=None, mesh=None, **conf_kw):
     from keystone_tpu.workloads.mnist_random_fft import (
         MnistRandomFFTConfig,
         run,
@@ -316,7 +326,7 @@ def _run_mnist(train_override=None, **conf_kw):
         num_classes=5,
         **conf_kw,
     )
-    return run(conf, train, test)
+    return run(conf, train, test, mesh=mesh)
 
 
 _cifar_paths_cache: list = []
@@ -340,7 +350,7 @@ def _write_synthetic_cifar(path, n, rng, num_classes=4, base=None):
     recs.tofile(path)
 
 
-def _run_cifar(train_override=None, **conf_kw):
+def _run_cifar(train_override=None, mesh=None, **conf_kw):
     from keystone_tpu.workloads.cifar_random_patch import (
         RandomCifarConfig,
         run,
@@ -368,27 +378,52 @@ def _run_cifar(train_override=None, **conf_kw):
     train, test = cifar_loader(tr), cifar_loader(te)
     if train_override is not None:
         train = train_override(train)
-    return run(conf, train, test)
+    return run(conf, train, test, mesh=mesh)
 
 
-def _run_workload(workload: str, train_override=None, **conf_kw):
+def _run_workload(workload: str, train_override=None, mesh=None, **conf_kw):
     if workload == "mnist":
-        return _run_mnist(train_override=train_override, **conf_kw)
+        return _run_mnist(train_override=train_override, mesh=mesh, **conf_kw)
     if workload == "cifar":
-        return _run_cifar(train_override=train_override, **conf_kw)
+        return _run_cifar(train_override=train_override, mesh=mesh, **conf_kw)
     raise ValueError(f"unknown chaos workload {workload!r}")
 
 
-_baselines: dict[str, dict] = {}
+_spec_mesh_cache: list = []
 
 
-def baseline(workload: str) -> dict:
+def _spec_mesh():
+    """The mesh the ``spec_mispredict`` family runs under: all live
+    devices, (data, model=2) when the count divides — so the search's
+    top-ranked plan is a real spec-executing GSPMD layout.  Cached: the
+    baseline and every faulted run must fit on the SAME mesh for the
+    bit-equality judgement to mean anything."""
+    if not _spec_mesh_cache:
+        import jax
+
+        from keystone_tpu.parallel.mesh import make_mesh
+
+        n = len(jax.devices())
+        model = 2 if n >= 2 and n % 2 == 0 else 1
+        _spec_mesh_cache.append(make_mesh(data=n // model, model=model))
+    return _spec_mesh_cache[0]
+
+
+_baselines: dict[tuple, dict] = {}
+
+
+def baseline(workload: str, mesh: bool = False) -> dict:
     """The fault-free run every schedule is judged against (cached — one
     per workload per process; also pre-warms every jit cache so faulted
-    runs measure fault handling, not compilation)."""
-    if workload not in _baselines:
-        _baselines[workload] = _run_workload(workload)
-    return _baselines[workload]
+    runs measure fault handling, not compilation).  ``mesh=True``: the
+    fault-free MESH run (the ``spec_mispredict`` oracle — a sharded
+    faulted run must be judged against a sharded baseline)."""
+    key = (workload, bool(mesh))
+    if key not in _baselines:
+        _baselines[key] = _run_workload(
+            workload, mesh=_spec_mesh() if mesh else None
+        )
+    return _baselines[key]
 
 
 def _preds_equal(a, b) -> bool:
@@ -1003,6 +1038,56 @@ def _serve_burst_oom_phase(fault: Fault, tmpdir: str, seed: int) -> None:
         )
 
 
+def _stepdown_oracle(
+    res: dict,
+    stepdown_delta: int,
+    *,
+    require_specs: bool = False,
+    require_mesh: bool = False,
+) -> None:
+    """Shared oracle of the plan/spec-mispredict families: the searched
+    placement record must prove the top-ranked plan died and the fit
+    chose the NEXT-ranked one, with the step-down counted.
+    ``require_mesh``/``require_specs`` additionally pin that the killed
+    plan was a mesh plan / a non-default spec-assignment layout."""
+    placement = res.get("placement")
+    if placement is None:
+        raise ChaosOracleError(
+            "no searched placement in results — the mispredict families "
+            "require the placement search to be active"
+        )
+    ranking, chosen = placement["ranking"], placement["chosen"]
+    top_rec = next(
+        (
+            c for c in placement["candidates"]
+            if ranking and c["name"] == ranking[0]
+        ),
+        {},
+    )
+    if require_mesh and not top_rec.get("mesh"):
+        raise ChaosOracleError(
+            f"top-ranked plan {ranking[0] if ranking else None!r} is not "
+            "a mesh plan — the schedule did not exercise a sharded layout"
+        )
+    if require_specs and not top_rec.get("specs"):
+        raise ChaosOracleError(
+            f"top-ranked plan {ranking[0] if ranking else None!r} carries "
+            "no spec assignment — the schedule killed the default layout, "
+            "not a searched spec layout"
+        )
+    if len(ranking) < 2 or chosen != ranking[1]:
+        raise ChaosOracleError(
+            f"top-ranked plan {ranking[0] if ranking else None!r} died "
+            f"but the fit chose {chosen!r}, not the next-ranked "
+            f"{ranking[1] if len(ranking) > 1 else None!r}"
+        )
+    if stepdown_delta < 1:
+        raise ChaosOracleError(
+            "the top-ranked plan died RESOURCE_EXHAUSTED but no "
+            f"autoshard_stepdown was counted (top candidate: {top_rec})"
+        )
+
+
 def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
     """Apply one schedule to the workload; returns the results dict (or
     raises).  Each branch is the minimal faithful injection for its
@@ -1068,25 +1153,57 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
             block_mod, "_execute_fused_bcd", failures=fault.params["failures"]
         ):
             res = _run_workload(workload)
-        placement = res.get("placement")
-        if placement is None:
-            raise ChaosOracleError(
-                "no searched placement in results — the mispredict family "
-                "requires the placement search to be active"
+        _stepdown_oracle(res, _counters.get("autoshard_stepdown") - before)
+        return res
+
+    if fault.kind == "spec_mispredict":
+        # The spec-ASSIGNMENT analog (ISSUE 10): the fault-free mesh
+        # baseline's placement table names the enumerated spec candidates;
+        # one on the head mesh shape is FORCED to the top of the faulted
+        # run's ranking (conf.solve_plan -> fit(plan=[name])), so the plan
+        # that dies at the GSPMD dispatch is a real non-default
+        # NamedSharding layout lowered from searched spec strings — not
+        # the same default rung plan_mispredict already kills.  The fit
+        # must step down the ranking (counted autoshard_stepdown) onto
+        # the default plan, and the judge then holds predictions
+        # bit-equal to the fault-free MESH baseline.
+        from keystone_tpu.core.resilience import counters as _counters
+
+        base_pl = baseline(workload, mesh=True).get("placement")
+        forced = None
+        if base_pl and base_pl.get("ranking"):
+            head = next(
+                (
+                    c for c in base_pl["candidates"]
+                    if c["name"] == base_pl["ranking"][0]
+                ),
+                {},
             )
-        ranking, chosen = placement["ranking"], placement["chosen"]
-        if len(ranking) < 2 or chosen != ranking[1]:
-            raise ChaosOracleError(
-                f"top-ranked plan {ranking[0] if ranking else None!r} died "
-                f"but the fit chose {chosen!r}, not the next-ranked "
-                f"{ranking[1] if len(ranking) > 1 else None!r}"
+            forced = next(
+                (
+                    [c["name"]] for c in base_pl["candidates"]
+                    if c.get("specs") and not c["pruned"]
+                    and c["mesh"] == head.get("mesh")
+                ),
+                None,
             )
-        top = placement["candidates"][0] if placement["candidates"] else {}
-        if _counters.get("autoshard_stepdown") - before < 1:
-            raise ChaosOracleError(
-                "the searched top plan died RESOURCE_EXHAUSTED but no "
-                f"autoshard_stepdown was counted (top candidate: {top})"
+        before = _counters.get("autoshard_stepdown")
+        with faults.oom_faults(
+            block_mod, "_execute_fused_bcd_mesh",
+            failures=fault.params["failures"],
+        ):
+            res = _run_workload(
+                workload, mesh=_spec_mesh(), solve_plan=forced
             )
+        _stepdown_oracle(
+            res,
+            _counters.get("autoshard_stepdown") - before,
+            # With >= 2 devices a spec candidate always exists; a 1x1 mesh
+            # has no non-default layouts, so the oracle degrades to the
+            # mesh-plan check there instead of passing vacuously.
+            require_specs=forced is not None,
+            require_mesh=True,
+        )
         return res
 
     if fault.kind == "nan_input":
@@ -1179,7 +1296,9 @@ def run_schedule(
     t0 = time.monotonic()
     result = ChaosResult(seed=seed, workload=workload, fault=fault, outcome="")
     with _clean_env():
-        base = baseline(workload)
+        # spec_mispredict runs under a mesh, so it is judged against the
+        # fault-free MESH baseline (same devices, same mesh shape).
+        base = baseline(workload, mesh=fault.kind == "spec_mispredict")
         if trace_path is not None:
             # Per-schedule timeline: clear the buffer so this trace holds
             # exactly this schedule's events (baseline is pre-cached above).

@@ -119,6 +119,80 @@ def test_best_spec_minimizes_per_chip_bytes_and_replicates_when_odd():
     )
 
 
+# -- spec strings -> executable layouts (ISSUE 10) -----------------------------
+
+
+def test_spec_pspec_lowers_every_vocabulary_entry():
+    from jax.sharding import PartitionSpec as P
+
+    assert autoshard.spec_pspec("replicated", 2) == P(None, None)
+    assert autoshard.spec_pspec("data@dim0", 2) == P("data", None)
+    assert autoshard.spec_pspec("model@dim1", 2) == P(None, "model")
+    assert autoshard.spec_pspec("model@dim2", 3) == P(None, None, "model")
+    with pytest.raises(ValueError):
+        autoshard.spec_pspec("bogus", 2)
+    with pytest.raises(ValueError):
+        autoshard.spec_pspec("data@dim5", 2)  # names a missing dim
+
+
+def test_spec_sharding_places_arrays_per_spec(mesh42):
+    import jax
+
+    a = jnp.zeros((8, 6), jnp.float32)
+    sharded = jax.device_put(
+        a, autoshard.spec_sharding("data@dim0", mesh42, 2)
+    )
+    # data axis 4: each chip holds a [2, 6] shard
+    shard_shape = sharded.sharding.shard_shape((8, 6))
+    assert shard_shape == (2, 6)
+    rep = jax.device_put(
+        a, autoshard.spec_sharding("replicated", mesh42, 2)
+    )
+    assert rep.sharding.shard_shape((8, 6)) == (8, 6)
+
+
+def test_spec_chip_bytes_matches_enumeration():
+    mesh_shape = {"data": 2, "model": 3}
+    aval = jnp.zeros((8, 6), jnp.float32)
+    for c in autoshard.spec_candidates(aval, mesh_shape):
+        assert autoshard.spec_chip_bytes(
+            (8, 6), jnp.float32, c["spec"], mesh_shape
+        ) == c["per_chip_bytes"]
+    with pytest.raises(ValueError):
+        autoshard.spec_chip_bytes((7,), jnp.float32, "data@dim0", mesh_shape)
+
+
+def test_spec_candidates_bytes_lower_bound_of_compiled_layouts(mesh42):
+    """The invariant the preflight pruning depends on (ISSUE 10 satellite):
+    for every enumerated spec, the analytic per-chip bytes are a true
+    LOWER bound of what the compiled admission charges for that executed
+    layout (max of the analytic shard division and XLA's own
+    memory_analysis, exactly as plan_program's mesh mode charges)."""
+    import jax
+
+    shapes = [(64, 48), (32, 8, 6)]
+    for shape in shapes:
+        aval = jnp.zeros(shape, jnp.float32)
+        for c in autoshard.spec_candidates(aval, dict(mesh42.shape)):
+            sharding = autoshard.spec_sharding(c["spec"], mesh42, len(shape))
+            s = jax.ShapeDtypeStruct(shape, jnp.float32, sharding=sharding)
+            compiled = jax.jit(lambda a: a * 2.0).lower(s).compile()
+            ma = compiled.memory_analysis()
+            charged = max(
+                kmem.shard_bytes(s), int(ma.argument_size_in_bytes)
+            )
+            assert c["per_chip_bytes"] <= charged, (
+                shape, c, charged, int(ma.argument_size_in_bytes),
+            )
+
+
+def test_spec_tag_compact():
+    assert autoshard.spec_tag(None) == "default"
+    assert autoshard.spec_tag(
+        {"models": "replicated", "labels": "model@dim1"}
+    ) == "labels=model@dim1,models=rep"
+
+
 # -- the zero-cost batch preflight --------------------------------------------
 
 
@@ -598,6 +672,370 @@ def test_fit_report_record_carries_placement(rng):
     json.dumps(rec)  # the whole audit trail must stay JSON-able
 
 
+# -- executed sharding specs (ISSUE 10) ---------------------------------------
+
+
+def test_mesh_search_enumerates_spec_candidates(rng):
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices (conftest forces 8 CPU devices)")
+    mesh = make_mesh(data=len(jax.devices()) // 2, model=2)
+    x, y = _small_problem(rng, n=256, d=128, k=4)
+    est = BlockLeastSquaresEstimator(64, num_iter=1, lam=1.0, mesh=mesh)
+    est.fit(x, y, plan=True)
+    p = est.last_fit_report.placement
+    spec_cands = [c for c in p["candidates"] if c.get("specs")]
+    assert spec_cands, "no spec-assignment candidates enumerated"
+    # the advertised layouts include the wide-class one on the hand mesh
+    tags = {(str(c["mesh"]), str(c["specs"])) for c in spec_cands}
+    assert any("model@dim1" in t for _m, t in tags)
+    # spec candidates are extras: the untrained head stays the hand rung
+    # (default layout), so the search is bit-compatible cold
+    head = [c for c in p["candidates"] if c["name"] == p["ranking"][0]][0]
+    assert head["specs"] is None
+    # every candidate row carries a calibration source for the audit trail
+    assert all(
+        c["calibration_source"] in ("direct", "model", "pooled", "none")
+        for c in p["candidates"] if not c["pruned"]
+    )
+
+
+def test_forced_spec_plan_executes_layout_bit_identical(rng):
+    """A spec-assignment candidate EXECUTES its NamedSharding layout (not
+    just byte accounting) and, on the same mesh shape, reproduces the
+    default layout's model bit-for-bit — layout changes placement, never
+    results."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    mesh = make_mesh(data=len(jax.devices()) // 2, model=2)
+    x, y = _small_problem(rng, n=256, d=128, k=4)
+    est = BlockLeastSquaresEstimator(64, num_iter=1, lam=1.0, mesh=mesh)
+    base = est.fit(x, y, plan=True)
+    p = est.last_fit_report.placement
+    head_mesh = [
+        c for c in p["candidates"] if c["name"] == p["ranking"][0]
+    ][0]["mesh"]
+    spec_names = [
+        c["name"] for c in p["candidates"]
+        if c.get("specs") and c["mesh"] == head_mesh
+    ]
+    assert spec_names
+    for name in spec_names:
+        est2 = BlockLeastSquaresEstimator(64, num_iter=1, lam=1.0, mesh=mesh)
+        replay = est2.fit(x, y, plan=[name])
+        assert est2.last_fit_report.chosen == name
+        chosen = [
+            c for c in est2.last_fit_report.placement["candidates"]
+            if c["name"] == name
+        ][0]
+        assert chosen["outcome"] == "ok" and chosen["specs"]
+        np.testing.assert_array_equal(
+            np.asarray(base.b), np.asarray(replay.b)
+        )
+        for a, b in zip(base.xs, replay.xs):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bwls_mesh_search_spec_candidates_execute(rng):
+    import jax
+
+    from keystone_tpu.solvers.weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    mesh = make_mesh(data=len(jax.devices()) // 2, model=2)
+    x, _ = _small_problem(rng, n=256, d=128, k=4)
+    y = jnp.asarray(
+        2.0 * np.eye(8, dtype=np.float32)[rng.integers(0, 8, 256)] - 1.0
+    )
+    est = BlockWeightedLeastSquaresEstimator(64, 1, 0.5, 0.5, mesh=mesh)
+    base = est.fit(x, y, plan=True)
+    p = est.last_fit_report.placement
+    head_mesh = [
+        c for c in p["candidates"] if c["name"] == p["ranking"][0]
+    ][0]["mesh"]
+    wide = [
+        c["name"] for c in p["candidates"]
+        if c.get("specs") == {"labels": "model@dim1"}
+        and c["mesh"] == head_mesh
+    ]
+    assert wide, "wide-class (model-axis-sharded labels) candidate missing"
+    est2 = BlockWeightedLeastSquaresEstimator(64, 1, 0.5, 0.5, mesh=mesh)
+    replay = est2.fit(x, y, plan=[wide[0]])
+    assert est2.last_fit_report.chosen == wide[0]
+    for a, b in zip(base.xs, replay.xs):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_specs_env_disables_spec_dimension(rng, monkeypatch):
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    monkeypatch.setenv(autoshard.SPECS_ENV, "0")
+    mesh = make_mesh(data=len(jax.devices()) // 2, model=2)
+    x, y = _small_problem(rng, n=256, d=128, k=4)
+    est = BlockLeastSquaresEstimator(64, num_iter=1, lam=1.0, mesh=mesh)
+    est.fit(x, y, plan=True)
+    p = est.last_fit_report.placement
+    assert not any(c.get("specs") for c in p["candidates"])
+
+
+def test_searched_featurize_placement(rng, mesh42):
+    """fv_common's featurize placement rides the same search machinery:
+    hand row-sharded layout at the untrained head (bit-identical default),
+    a placement record with the spec column, single-device floor last."""
+    from keystone_tpu.workloads.fv_common import (
+        bucket_by_shape,
+        searched_bucket_featurize,
+        shard_batch,
+    )
+
+    images = [
+        rng.integers(0, 255, (24, 16, 3)).astype(np.uint8) for _ in range(6)
+    ] + [
+        rng.integers(0, 255, (16, 16, 3)).astype(np.uint8) for _ in range(4)
+    ]
+    per_batch = lambda dev: jnp.asarray(dev, jnp.float32).sum(  # noqa: E731
+        axis=(1, 2, 3), keepdims=True
+    )[:, :, None]
+    out, placement = searched_bucket_featurize(
+        "test_featurize", images, per_batch, mesh42
+    )
+    assert placement is not None
+    assert placement["ranking"][0].startswith("row_sharded[mesh 4x2]")
+    assert placement["ranking"][-1] == "single_device"
+    assert placement["chosen"] == placement["ranking"][0]
+    # bit-identical to the hand path
+    hand = {
+        shape: (idx, per_batch(shard_batch(batch, mesh42)))
+        for shape, (idx, batch) in bucket_by_shape(images).items()
+    }
+    assert set(out) == set(hand)
+    for shape in out:
+        np.testing.assert_array_equal(
+            np.asarray(out[shape][1]), np.asarray(hand[shape][1])
+        )
+    # no mesh -> plain hand path, no record
+    out2, rec2 = searched_bucket_featurize(
+        "test_featurize", images, per_batch, None
+    )
+    assert rec2 is None and set(out2) == set(hand)
+
+
+# -- the cross-program calibration model (ISSUE 10) ----------------------------
+
+
+def _feat(kind="fused", bytes_=1e6, flops=1e9, data=1, model=1):
+    return autoshard.plan_features(
+        kind, {"data": data, "model": model},
+        {"arg_bytes": bytes_, "flops": flops, "dispatches": 1},
+    )
+
+
+def test_calibration_model_learns_constant_ratio():
+    from keystone_tpu.core import optimize as kopt
+
+    rows = [
+        (f"fp{i}", _feat(bytes_=10.0 ** (5 + i % 3)), 3.0) for i in range(10)
+    ]
+    model = kopt.CalibrationModel.fit_rows(rows)
+    assert model is not None
+    assert model.n_programs == 10
+    # a constant measured/prior ratio is learned to ~3x for any features
+    assert model.predict_factor(_feat(bytes_=2e6)) == pytest.approx(
+        3.0, rel=0.05
+    )
+
+
+def test_calibration_model_factor_clipped():
+    from keystone_tpu.core import optimize as kopt
+
+    rows = [("a", _feat(bytes_=1e5), 1e9), ("b", _feat(bytes_=1e6), 1e9)]
+    model = kopt.CalibrationModel.fit_rows(rows)
+    assert model.predict_factor(_feat(bytes_=1e7)) <= 32.0
+
+
+def test_calibrate_uses_cross_program_model_for_unseen_program(
+    tmp_path, monkeypatch
+):
+    """Outcomes logged for OTHER programs train a model that transfers to
+    a fingerprint the log never saw — the source says 'model', the direct
+    sample count stays 0 (so the margin stays cold: conservative rules
+    preserved)."""
+    path = str(tmp_path / "plans.jsonl")
+    monkeypatch.setenv(autoshard.PLAN_LOG_ENV, path)
+    autoshard.clear_outcome_cache()
+    try:
+        for i in range(10):
+            rec = _log_record(f"{i:016x}", "fused", 1.0, 4.0)
+            rec["raw_seconds"] = 1.0
+            rec["features"] = _feat(bytes_=10.0 ** (5 + i % 3))
+            autoshard.append_outcome(rec)
+        autoshard.clear_outcome_cache()
+        factor, n, source = autoshard.calibrate(
+            "f" * 16, "fused", features=_feat(bytes_=2e6)
+        )
+        assert source == "model"
+        assert n == 0
+        assert factor == pytest.approx(4.0, rel=0.1)
+        # featureless lookups keep the old direct->pooled->1.0 ladder
+        assert autoshard.calibration("f" * 16, "fused") == (1.0, 0)
+    finally:
+        autoshard.clear_outcome_cache()
+
+
+def test_empty_log_keeps_untrained_hand_order_with_model_path(
+    tmp_path, monkeypatch
+):
+    # The acceptance bar: with an EMPTY plan log the searched ranking
+    # (specs included) reproduces the hand order — no model, no pooled
+    # median, factor 1.0 everywhere.
+    monkeypatch.setenv(
+        autoshard.PLAN_LOG_ENV, str(tmp_path / "empty.jsonl")
+    )
+    autoshard.clear_outcome_cache()
+    try:
+        plan = _search([_mk_cand("a", 0, 10), _mk_cand("b", 1, 7)])
+        assert plan.ranking == ["a", "b"]
+        assert all(
+            c.calibration == 1.0 and c.calibration_source == "none"
+            for c in plan.candidates
+        )
+    finally:
+        autoshard.clear_outcome_cache()
+
+
+# -- plan-log cap + compaction (ISSUE 10 satellite) ----------------------------
+
+
+def test_plan_log_cap_compacts_oldest_first(tmp_path, monkeypatch):
+    path = str(tmp_path / "plans.jsonl")
+    monkeypatch.setenv(autoshard.PLAN_LOG_ENV, path)
+    monkeypatch.setenv(autoshard.PLAN_LOG_MAX_ENV, "50")
+    autoshard.clear_outcome_cache()
+    try:
+        # Pre-seed an OVERSIZED log: an old fingerprint with constant
+        # ratio 2.0 spread over many stale records, then a hot one.
+        with open(path, "w") as f:
+            for i in range(400):
+                f.write(json.dumps(_log_record("old" + "0" * 13, "fused",
+                                               1.0, 2.0)) + "\n")
+            for i in range(40):
+                f.write(json.dumps(_log_record("hot" + "0" * 13, "fused",
+                                               1.0, 5.0)) + "\n")
+        autoshard.append_outcome(_log_record("hot" + "0" * 13, "fused",
+                                             1.0, 5.0))
+        with open(path) as f:
+            lines = [ln for ln in f if ln.strip()]
+        assert len(lines) <= 51  # cap + the appended record
+        autoshard.clear_outcome_cache()
+        # medians stable through compaction (constant per-pair ratios)
+        assert autoshard.calibration("old" + "0" * 13, "fused")[0] == (
+            pytest.approx(2.0)
+        )
+        assert autoshard.calibration("hot" + "0" * 13, "fused")[0] == (
+            pytest.approx(5.0)
+        )
+    finally:
+        autoshard.clear_outcome_cache()
+
+
+def test_plan_log_cap_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(autoshard.PLAN_LOG_MAX_ENV, "off")
+    assert autoshard.plan_log_max() is None
+    monkeypatch.delenv(autoshard.PLAN_LOG_MAX_ENV)
+    assert autoshard.plan_log_max() == 20_000
+
+
+def test_plan_log_cap_malformed_env_never_crashes_append(
+    tmp_path, monkeypatch
+):
+    """Telemetry must never crash a solve: a malformed or negative
+    KEYSTONE_PLAN_LOG_MAX raises at plan_log_max() (fail-fast grammar)
+    but append_outcome degrades counted — and never wipes the log."""
+    from keystone_tpu.core.resilience import counters
+
+    path = str(tmp_path / "plans.jsonl")
+    monkeypatch.setenv(autoshard.PLAN_LOG_ENV, path)
+    autoshard.clear_outcome_cache()
+    try:
+        # seed one good record under a valid cap
+        autoshard.append_outcome(_log_record("a" * 16, "fused", 1.0, 2.0))
+        seeded = open(path).read()
+        assert seeded
+        for bad in ("unlimited", "-5"):
+            monkeypatch.setenv(autoshard.PLAN_LOG_MAX_ENV, bad)
+            with pytest.raises(ValueError):
+                autoshard.plan_log_max()
+            before = counters.get("plan_log_write_failed")
+            autoshard.append_outcome(_log_record("a" * 16, "fused", 1.0, 2.0))
+            assert counters.get("plan_log_write_failed") - before == 1
+        # the seeded record survived — no negative-cap wipe, no torn write
+        assert open(path).read() == seeded
+    finally:
+        autoshard.clear_outcome_cache()
+
+
+def test_compact_log_tiny_cap_trims_never_wipes(tmp_path):
+    # cap below the per-pair keep tail: a single-pair log must TRIM to
+    # the watermark, not evict its only pair (wiping all history).
+    path = str(tmp_path / "plans.jsonl")
+    with open(path, "w") as f:
+        for i in range(30):
+            f.write(json.dumps(_log_record("a" * 16, "fused", 1.0,
+                                           float(i))) + "\n")
+    n = autoshard.compact_log(path, 5)
+    assert 1 <= n <= 5
+    kept = [json.loads(ln) for ln in open(path)]
+    assert len(kept) == n
+    # survivors are the NEWEST records
+    assert kept[-1]["measured_seconds"] == 29.0
+
+
+def test_compact_log_keeps_newest_per_pair(tmp_path):
+    path = str(tmp_path / "plans.jsonl")
+    with open(path, "w") as f:
+        for i in range(30):
+            r = _log_record("a" * 16, "fused", 1.0, float(i))
+            f.write(json.dumps(r) + "\n")
+    n = autoshard.compact_log(path, 10)
+    assert n <= 10
+    kept = [json.loads(ln) for ln in open(path)]
+    # oldest-first: the survivors are the NEWEST records
+    assert [r["measured_seconds"] for r in kept] == list(
+        range(30 - len(kept), 30)
+    )
+
+
+# -- mesh-enumeration memoization (ISSUE 10 satellite) -------------------------
+
+
+def test_enumerate_meshes_memoized_per_device_tuple():
+    import jax
+
+    devices = jax.devices()
+    a = enumerate_meshes(devices)
+    b = enumerate_meshes(devices)
+    # same Mesh OBJECTS back (the construction happened once), but a
+    # fresh list each call (callers may mutate their copy)
+    assert a is not b
+    assert all(x is y for x, y in zip(a, b))
+
+
+def test_enumerate_mesh_shapes_memoized_returns_fresh_list():
+    a = enumerate_mesh_shapes(8)
+    b = enumerate_mesh_shapes(8)
+    assert a == b and a is not b
+    a.append(("junk", 0))
+    assert enumerate_mesh_shapes(8) == b  # cache not polluted
+
+
 # -- tools/plan_view.py -------------------------------------------------------
 
 
@@ -622,6 +1060,24 @@ def test_plan_view_finds_all_embedded_plans():
     }
     doc = {"a": [plan, {"b": plan}], "c": plan}
     assert len(plan_view.find_plans(doc)) == 3
+
+
+def test_plan_view_renders_spec_column(rng, tmp_path):
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    mesh = make_mesh(data=len(jax.devices()) // 2, model=2)
+    x, y = _small_problem(rng, n=256, d=128, k=4)
+    est = BlockLeastSquaresEstimator(64, num_iter=1, lam=1.0, mesh=mesh)
+    est.fit(x, y, plan=True)
+    doc = {"solver": est.last_fit_report.record()}
+    path = tmp_path / "results.json"
+    path.write_text(json.dumps(doc))
+    out = plan_view.summarize(str(path))
+    assert "specs" in out  # the spec column header
+    assert "labels=model@dim1" in out  # a spec assignment rendered
+    assert "default" in out  # hand rungs show the default layout
 
 
 def test_plan_view_summarizes_outcome_log(tmp_path):

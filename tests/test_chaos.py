@@ -32,6 +32,7 @@ EXPECTED_COUNTER = {
     "malformed_request": "serve_malformed_request",
     "serve_burst_oom": "serve_burst_oom",
     "plan_mispredict": "autoshard_stepdown",
+    "spec_mispredict": "autoshard_stepdown",
 }
 
 
@@ -78,6 +79,10 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # Placement-search coverage (ISSUE 9): a mispredicted top-ranked plan
     # must step down the SEARCHED ranking typed + counted
     assert "plan_mispredict" in kinds
+    # Spec-execution coverage (ISSUE 10): a mispredicted SPEC-SHARDED
+    # (GSPMD-layout) top plan must step down counted and stay bit-equal
+    # to the fault-free mesh run
+    assert "spec_mispredict" in kinds
 
 
 def test_schedules_are_deterministic():

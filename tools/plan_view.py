@@ -8,8 +8,10 @@ Two input kinds:
   ``extra_metrics.placement.shapes[*]``), or any JSON containing
   ``FitReport.record()`` output: every embedded ``PlacementPlan`` record
   is found recursively and printed as a candidate table — rank, mesh,
-  predicted cost with its calibration provenance, deny reason for pruned
-  candidates, and the chosen plan's predicted-vs-actual cost;
+  the per-operand SPEC assignment the candidate executes (ISSUE 10),
+  predicted cost with its calibration provenance (direct / cross-program
+  model / pooled), deny reason for pruned candidates, and the chosen
+  plan's predicted-vs-actual cost;
 * the **plan-outcome log** (``~/.keystone_plans.jsonl`` /
   ``KEYSTONE_PLAN_LOG``, any ``*.jsonl`` path): measured outcomes grouped
   by program fingerprint and candidate — sample counts, ok/oom split, and
@@ -64,8 +66,8 @@ def format_plan(plan: dict) -> str:
     ]
     header = (
         f"{'rank':>4} {'candidate':<28} {'kind':<12} {'mesh':<8} "
-        f"{'predicted':>10} {'calib':>7} {'n':>3} {'measured':>10} "
-        f"{'outcome':<8} note"
+        f"{'specs':<24} {'predicted':>10} {'calib':>7} {'src':<6} "
+        f"{'n':>3} {'measured':>10} {'outcome':<8} note"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -81,6 +83,14 @@ def format_plan(plan: dict) -> str:
         mesh_s = (
             f"{mesh.get('data', '?')}x{mesh.get('model', '?')}" if mesh else "-"
         )
+        specs = c.get("specs")
+        specs_s = (
+            ",".join(
+                f"{k}={'rep' if v == 'replicated' else v}"
+                for k, v in sorted(specs.items())
+            )
+            if specs else "default"
+        )
         mark = "*" if c.get("name") == chosen else " "
         note = ""
         if c.get("pruned"):
@@ -88,8 +98,11 @@ def format_plan(plan: dict) -> str:
         lines.append(
             f"{c.get('rank') if c.get('rank') is not None else '-':>4}"
             f"{mark}{c.get('name', '?'):<27} {c.get('kind', '?'):<12} "
-            f"{mesh_s:<8} {_fmt_s(c.get('predicted_seconds')):>10} "
-            f"{c.get('calibration', 1.0):>7.3g} {c.get('samples', 0):>3} "
+            f"{mesh_s:<8} {specs_s:<24} "
+            f"{_fmt_s(c.get('predicted_seconds')):>10} "
+            f"{c.get('calibration', 1.0):>7.3g} "
+            f"{c.get('calibration_source', '-') or '-':<6} "
+            f"{c.get('samples', 0):>3} "
             f"{_fmt_s(c.get('measured_seconds')):>10} "
             f"{c.get('outcome') or '-':<8} {note}"
         )
