@@ -1727,6 +1727,7 @@ def bench_serving(rng):
 
     cfg = kserve.ServeConfig(buckets=(1, 4, 16), max_wait_ms=2.0)
     tmp = tempfile.mkdtemp(prefix="bench_serve_")
+    engines = {}
 
     def slo(pipe, example, requests, label):
         stem = os.path.join(tmp, f"{label}_pipe")
@@ -1734,6 +1735,7 @@ def bench_serving(rng):
         engine, cold = kserve.load_engine(
             stem, example, config=cfg, label=label
         )
+        engines[label] = engine
         rec = kserve.serve_bench(engine, requests, clients=4, depth=16)
         rec["cold_start"] = cold
         return rec
@@ -1787,8 +1789,66 @@ def bench_serving(rng):
             imgs[:192],
             "cifar_conv",
         )
+
+        # -- telemetry overhead (ISSUE 11 acceptance: < 2% p99) ---------------
+        # The SAME warm engine serves the same request set twice: once with
+        # the telemetry tier off (flight ring depth 0, SLO observation
+        # suspended), once with it on — the p99 ratio IS the overhead of
+        # the always-on production telemetry.
+        from keystone_tpu.core import telemetry as ktelemetry
+
+        probe_engine = engines["mnist_fft"]
+        probe_reqs = x[:256]
+        with ktelemetry.telemetry_disabled():
+            off = kserve.serve_bench(
+                probe_engine, probe_reqs, clients=4, depth=16,
+                unbatched_baseline=False,
+            )
+        on = kserve.serve_bench(
+            probe_engine, probe_reqs, clients=4, depth=16,
+            unbatched_baseline=False,
+        )
+        out["telemetry_overhead"] = {
+            "requests": int(probe_reqs.shape[0]),
+            "p99_off_ms": off["p99_latency_ms"],
+            "p99_on_ms": on["p99_latency_ms"],
+            "qps_off": off["qps"],
+            "qps_on": on["qps"],
+            "p99_overhead_frac": round(
+                on["p99_latency_ms"] / max(off["p99_latency_ms"], 1e-9) - 1.0,
+                4,
+            ),
+            "target_frac": 0.02,
+        }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def bench_self_diff(record: dict, dirpath: str | None = None) -> dict:
+    """Regression observatory (ISSUE 11): compare THIS round's record
+    against the newest USABLE prior ``BENCH_r*.json`` (a truncated newest
+    round — r05's ``parsed: null`` — falls back to the round before it)
+    via ``tools/bench_diff.py``'s thresholds, and embed the verdict in the
+    round artifact so every hardware round self-reports regressions."""
+    import sys
+
+    root = os.path.dirname(os.path.abspath(__file__)) or "."
+    tools_dir = os.path.join(root, "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import bench_diff
+
+    prev = bench_diff.latest_usable_round(dirpath or root)
+    if prev is None:
+        return {"note": "no usable prior BENCH round on record"}
+    num, path, base = prev
+    out = bench_diff.compare(base, record)
+    out["baseline"] = os.path.basename(path)
+    out["baseline_round"] = num
+    # The full per-metric table stays in the tool; the embedded section
+    # keeps the verdict + the rows that moved (artifact size discipline).
+    out.pop("rows", None)
     return out
 
 
@@ -1924,6 +1984,9 @@ def main():
             "placement": placement,
         },
     }
+    # Regression observatory (ISSUE 11): this round judged against the
+    # newest usable prior round's record, verdict embedded in the artifact.
+    record["bench_diff"] = _guarded(lambda _rng: bench_self_diff(record), rng)
     # Artifact-truncation guard (VERDICT r5 "Driver artifacts"): the driver
     # keeps a bounded TAIL of stdout, and round 5's record — one JSON line
     # emitted last, after all bench log noise — got cut mid-record
@@ -2011,14 +2074,33 @@ def main():
         print(f"# serving: {srv['error'][:120]}")
     else:
         for wk, r in srv.items():
+            if wk == "telemetry_overhead":
+                print(
+                    f"# serving telemetry overhead: p99 {r['p99_off_ms']}ms "
+                    f"off -> {r['p99_on_ms']}ms on "
+                    f"({r['p99_overhead_frac']:+.2%}, target < "
+                    f"{r['target_frac']:.0%})"
+                )
+                continue
+            burn = r.get("slo", {}).get("window", {}).get("burn_rate")
             print(
                 f"# serving {wk}: p50 {r['p50_latency_ms']}ms / p99 "
                 f"{r['p99_latency_ms']}ms, {r['qps']} QPS "
                 f"(x{r.get('batched_vs_unbatched_qps')} vs unbatched), "
-                f"occupancy {r['batcher']['mean_occupancy']}, cold start "
+                f"occupancy {r['batcher']['mean_occupancy']}, burn_rate "
+                f"{burn}, cold start "
                 f"{r['cold_start']['cold_start_seconds']}s, bit_identical "
                 f"{r['predictions_bit_identical']}"
             )
+    bd = record["bench_diff"]
+    if "verdict" in bd:
+        print(
+            f"# bench_diff vs {bd.get('baseline')}: {bd['verdict']} "
+            f"({bd.get('compared')} compared, "
+            f"{len(bd.get('regressions', []))} regression(s))"
+        )
+    else:
+        print(f"# bench_diff: {bd.get('note') or bd.get('error')}")
     print(f"# faults: {record['faults'] if record['faults'] else 'none'}")
 
 

@@ -975,6 +975,13 @@ def search(
         pruned=[r.name for r in records if r.pruned],
         trained=trained,
     )
+    # The search itself is part of the metrics surface (ISSUE 11): how
+    # many searches this process ran, how long they take, and whether the
+    # cost model was trained — readable from one registry snapshot next
+    # to the serving/ingest/fault groups.
+    trace.metrics.inc("autoshard_searches")
+    trace.metrics.observe("autoshard_search_seconds", plan.search_seconds)
+    trace.metrics.gauge("autoshard_last_search_trained", 1.0 if trained else 0.0)
     _logger.info("%s", plan.summary())
     return plan
 

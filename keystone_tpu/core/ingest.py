@@ -1275,6 +1275,9 @@ class IngestStream:
         m.gauge("ingest_ring_capacity", self.config.ring_capacity)
         m.gauge("ingest_producer_stalls", self.stats.producer_stalls)
         m.gauge("ingest_consumer_stalls", self.stats.consumer_stalls)
+        m.gauge("ingest_decoded", self.stats.decoded)
+        m.gauge("ingest_snapshot_chunks_read", self.stats.snapshot_chunks_read)
+        m.gauge("ingest_worker_respawns", self.stats.worker_respawns)
 
     def _drain(self):
         pending: collections.deque = collections.deque()
@@ -1319,6 +1322,19 @@ class IngestStream:
         automatically on stream exhaustion, consumer exception, or context
         exit."""
         self._ring.stop()
+        # Close the drain generator too: a consumer that stopped early
+        # leaves it SUSPENDED at the yield inside an open ingest.consume
+        # span, and a suspended span sits on this thread's span stack
+        # corrupting every later span's depth/parent (and the flight
+        # recorder's view) until the generator is garbage-collected.
+        # Closing delivers GeneratorExit at the yield — the span exits as
+        # aborted and pops.  ValueError = close() reached from INSIDE the
+        # running generator (the exhaustion path's own finally); it is
+        # already unwinding, nothing to do.
+        try:
+            self._iter.close()
+        except ValueError:
+            pass
 
     def join(self, timeout: float = 10.0) -> bool:
         """Wait for the producer, every decoder thread, AND every decode

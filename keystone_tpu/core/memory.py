@@ -738,6 +738,8 @@ class FitReport:
 
     def record(self) -> dict:
         """JSON-able form for bench artifacts."""
+        from . import telemetry
+
         return {
             "chosen_tier": self.chosen,
             "mesh_shape": dict(self.mesh_shape) if self.mesh_shape else None,
@@ -748,6 +750,9 @@ class FitReport:
             "oom_retries": list(self.oom_retries),
             "tiers": {k: p.breakdown() for k, p in self.plans.items()},
             "placement": self.placement,
+            # Flight-recorder postmortems this process has dumped
+            # (core.telemetry) — a degraded fit links to its evidence.
+            "postmortems": telemetry.postmortem_paths(),
         }
 
     def summary(self) -> str:

@@ -30,6 +30,7 @@ import functools
 import logging
 import os
 import signal
+import sys
 import threading
 import time
 from typing import Callable
@@ -194,6 +195,16 @@ class FaultCounters:
         _logger.warning(
             "%s #%d%s", kind, total, f": {detail}" if detail else ""
         )
+        # Flight-recorder postmortem (core.telemetry): a typed fault of a
+        # postmortem family dumps the recent-event ring + a counters
+        # snapshot when KEYSTONE_POSTMORTEM_DIR is set.  OUTSIDE the
+        # counter lock: the dump snapshots the metrics registry, whose
+        # "faults" group re-enters THIS ledger's snapshot.  Function-local
+        # import (a sys.modules lookup at this point) because the module-
+        # level binding only exists below this class definition.
+        from . import telemetry
+
+        telemetry.maybe_postmortem(kind, detail=detail, total=total)
         return total
 
     def counts(self) -> dict[str, int]:
@@ -226,6 +237,12 @@ counters = FaultCounters()
 # The fault ledger rides along in every metrics snapshot as the "faults"
 # group — one atomic record captures perf metrics AND degradation events.
 trace.metrics.adopt("faults", counters)
+
+# Activate the telemetry exporters (KEYSTONE_METRICS_FILE / _PORT) for any
+# process that can survive a fault — i.e. any importer of this module.
+# telemetry is jax-free and defers http.server until a port is asked for,
+# so the decode workers' import-cost discipline holds.
+from . import telemetry  # noqa: E402,F401  (env-activated exporters)
 
 
 def numerics_guard_enabled() -> bool:
@@ -278,6 +295,10 @@ class DeadlineExceeded(RuntimeError):
         )
         self.phase = phase
         self.seconds = seconds
+        #: When the trip fired — lets an enclosing deadline's handler tell
+        #: "this error is still UNWINDING (raised microseconds ago)" from
+        #: "someone caught it and their recovery path is now hanging".
+        self.raised_at = time.monotonic()
 
 
 @contextlib.contextmanager
@@ -308,6 +329,21 @@ def deadline(seconds: float, phase: str = "work"):
     t0 = time.monotonic()
 
     def _trip(signum, frame):
+        current = sys.exc_info()[1]
+        if (
+            isinstance(current, DeadlineExceeded)
+            and time.monotonic() - getattr(current, "raised_at", 0.0) < 0.25
+        ):
+            # A deadline error raised MOMENTS ago is still unwinding
+            # through this thread: an inner trip racing the enclosing
+            # deadline's re-armed timer (the 1e-3 floor below).  Raising
+            # now would REPLACE the inner trip's phase attribution
+            # mid-unwind, so postpone briefly.  The recency bound keeps
+            # the enclosing deadline REAL: an `except DeadlineExceeded:`
+            # suite holds exc_info for its whole body, and without the
+            # bound a hung recovery path would be postponed forever.
+            signal.setitimer(signal.ITIMER_REAL, 0.05)
+            return
         counters.record(
             "deadline_exceeded", f"{phase}: wall clock exceeded {budget:g}s"
         )

@@ -35,11 +35,18 @@ Three pieces:
   micro-batch *i+1* while the executor thread runs batch *i*, and only
   the executor ever blocks on device work.  Each request is answered with
   its own output slice, in arrival order.
-* **Observability + typed failure** — per-request ``serve.request`` spans
-  carry the queue-wait / H2D / execute / D2H breakdown (plus real
-  ``serve.h2d`` / ``serve.execute`` / ``serve.d2h`` spans per
-  micro-batch), latency/occupancy land in the ``trace.metrics``
-  histograms, and the typed-or-equal invariant extends online: a
+* **Observability + typed failure** — every request gets an id at
+  ``submit`` that rides through its whole lifecycle (a ``serve.submit``
+  instant, request-id ranges on the per-micro-batch ``serve.h2d`` /
+  ``serve.execute`` / ``serve.d2h`` spans, and a per-request
+  ``serve.request`` span), and a per-phase latency decomposition —
+  queue-wait / H2D / device-wait / execute / D2H / answer / pad overhead
+  — lands on ``ServeFuture.phases`` and aggregates in ``serve_bench``'s
+  ``phase_breakdown``.  Each ``Server`` registers a live SLO tracker
+  (``core.telemetry``: rolling p50/p99/QPS + error-budget burn rate
+  against ``KEYSTONE_SERVE_SLO_MS``), batcher state exports into the
+  ``trace.metrics`` registry (flush-reason counters, bucket retirements,
+  occupancy), and the typed-or-equal invariant extends online: a
   malformed request dies at ``submit`` with a counted
   :class:`MalformedRequest` and NEVER enters a batch (no poisoned
   batchmates); a burst OOM degrades to a smaller bucket (counted
@@ -84,6 +91,7 @@ from typing import Any, Callable, Sequence
 import numpy as np
 
 from . import memory as kmem
+from . import telemetry
 from . import trace
 from .resilience import counters
 
@@ -448,6 +456,11 @@ class ServingEngine:
         with self._lock:
             self._exec.pop(bucket, None)
             remaining = sorted(self._exec)
+        # Retirements land in the metrics registry too (ISSUE 11): one
+        # snapshot() shows the endpoint's degradation state alongside the
+        # fault ledger's serve_burst_oom count.
+        trace.metrics.inc("serve_bucket_retired")
+        trace.metrics.gauge("serve_live_buckets", len(remaining))
         counters.record(
             "serve_burst_oom",
             f"serve:{self.label}: bucket {bucket} {why} — degraded to "
@@ -597,16 +610,29 @@ def load_engine(
 
 class ServeFuture:
     """Handle for one submitted request.  ``result()`` blocks until the
-    batcher answers (the request's own output slice) or fails it typed."""
+    batcher answers (the request's own output slice) or fails it typed.
 
-    __slots__ = ("_event", "_value", "_error", "t_submit", "t_answer")
+    Lifecycle telemetry (ISSUE 11): ``request_id`` is minted at
+    ``Server.submit`` and rides through every span the request touches
+    (queue -> batch assembly -> H2D -> execute -> slice -> answer), and
+    ``phases`` holds the per-phase latency decomposition — queue-wait,
+    H2D, device-wait (time parked in the in-flight handoff), execute,
+    D2H, answer, and the estimated pad overhead — filled when the
+    request resolves."""
 
-    def __init__(self):
+    __slots__ = (
+        "_event", "_value", "_error", "t_submit", "t_answer",
+        "request_id", "phases",
+    )
+
+    def __init__(self, request_id: int = 0):
         self._event = threading.Event()
         self._value = None
         self._error: BaseException | None = None
         self.t_submit = time.perf_counter()
         self.t_answer = 0.0
+        self.request_id = request_id
+        self.phases: dict | None = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -650,6 +676,9 @@ class ServerStats:
     def record(self) -> dict:
         out = dataclasses.asdict(self)
         out["mean_occupancy"] = round(self.occupancy(), 4)
+        # Flight-recorder postmortems written this process (core.telemetry)
+        # — the serving stats record links straight to the evidence files.
+        out["postmortems"] = telemetry.postmortem_paths()
         return out
 
 
@@ -674,6 +703,12 @@ class Server:
         self.engine = engine
         self.config = config or engine.config
         self.stats = ServerStats()
+        #: live SLO surface for this endpoint (core.telemetry): rolling
+        #: p50/p99/QPS and error-budget burn rate against the
+        #: KEYSTONE_SERVE_SLO_MS target; registered so metrics.snapshot()
+        #: carries it under the "slo" group.
+        self.slo = telemetry.register_slo(engine.label)
+        self._next_id = 0
         self._queue: list = []  # pending _Request entries, arrival order
         self._cond = threading.Condition()
         self._stopped = False
@@ -704,15 +739,22 @@ class Server:
         raise :class:`MalformedRequest` HERE, counted, without ever
         entering a batch."""
         arr = self._validate(x)
-        fut = ServeFuture()
         with self._cond:
             if self._stopped:
                 raise ServingUnavailable("server is closed")
+            self._next_id += 1
+            fut = ServeFuture(request_id=self._next_id)
             self._queue.append((arr, fut))
             self.stats.requests += 1
             self.stats.queue_peak = max(self.stats.queue_peak, len(self._queue))
             trace.metrics.gauge("serve_queue_depth", len(self._queue))
+            trace.metrics.gauge("serve_queue_peak", self.stats.queue_peak)
             self._cond.notify_all()
+        # The request's birth on the timeline (and in the flight ring):
+        # the id minted here is the key every later lifecycle span carries.
+        trace.instant(
+            "serve.submit", request_id=fut.request_id, label=self.engine.label
+        )
         return fut
 
     def predict(self, x, timeout: float | None = 30.0):
@@ -789,12 +831,17 @@ class Server:
                         self.stats.flush_idle += 1
                         reason = "idle"
                     else:
+                        reason = None
+                    if reason is None:
                         remaining = max_wait - (time.perf_counter() - oldest)
                         self._cond.wait(min(remaining, _POLL_SECONDS))
                         continue
                     batch = self._queue[:max_batch]
                     del self._queue[:max_batch]
                     trace.metrics.gauge("serve_queue_depth", len(self._queue))
+                    # Flush reasons are registry counters too, so one
+                    # snapshot() shows the batcher's trigger mix.
+                    trace.metrics.inc(f"serve_flush_{reason}")
                     trace.instant(
                         "serve_flush", reason=reason, rows=len(batch),
                         queued=len(self._queue),
@@ -827,14 +874,22 @@ class Server:
                     n = rows.shape[0]
                     t_assembled = time.perf_counter()
                     padded = self.engine._pad(rows, bucket)
-                    self.stats.padded_rows += padded.shape[0] - n
+                    pad = padded.shape[0] - n
+                    self.stats.padded_rows += pad
+                    if pad:
+                        trace.metrics.inc("serve_padded_rows", pad)
                     # Dispatch the H2D NOW (async) — it overlaps the
-                    # executor's work on the previous micro-batch.
+                    # executor's work on the previous micro-batch.  The
+                    # span carries the micro-batch's request-id range so a
+                    # postmortem can tie a transfer to its victims.
                     with trace.io_span(
-                        "serve.h2d", padded.nbytes, cat="serve", bucket=bucket
+                        "serve.h2d", padded.nbytes, cat="serve", bucket=bucket,
+                        req_first=futs[0].request_id,
+                        req_last=futs[-1].request_id,
                     ):
                         dev = self.engine._jax.device_put(padded)
-                    entry = (futs, rows, dev, bucket, t_assembled)
+                    t_h2d_done = time.perf_counter()
+                    entry = (futs, rows, dev, bucket, t_assembled, t_h2d_done)
                     with self._inflight_cond:
                         while (
                             len(self._inflight) >= INFLIGHT_BATCHES
@@ -882,12 +937,16 @@ class Server:
                     self._cond.notify_all()
 
     def _run_batch(self, entry) -> None:
-        futs, rows, dev, bucket, t_assembled = entry
+        futs, rows, dev, bucket, t_assembled, t_h2d_done = entry
         n = len(futs)
+        degraded = False
+        t_exec_start = time.perf_counter()
         try:
             try:
                 with trace.span(
-                    "serve.execute", cat="serve", bucket=bucket, rows=n
+                    "serve.execute", cat="serve", bucket=bucket, rows=n,
+                    req_first=futs[0].request_id,
+                    req_last=futs[-1].request_id,
                 ) as sp:
                     out = sp.sync(self.engine._execute(bucket, dev))
                 t_exec = time.perf_counter()
@@ -920,6 +979,7 @@ class Server:
                 # endpoint stays up (the tf-serving degradation ladder).
                 host = self.engine.infer(rows)
                 t_exec = t_d2h = time.perf_counter()
+                degraded = True
         except BaseException as e:  # noqa: BLE001 — typed delivery
             counters.record(
                 "serve_batch_failed", f"{type(e).__name__}: {e}"
@@ -929,31 +989,61 @@ class Server:
         self.stats.batches += 1
         self.stats.answered += n
         self.stats.occupancy_sum += n / bucket
+        trace.metrics.inc("serve_batches")
         trace.metrics.observe("serve_batch_occupancy", n / bucket)
+        trace.metrics.gauge("serve_mean_occupancy", self.stats.occupancy())
+        pad = bucket - n if bucket > n else 0
+        execute_ms = (t_exec - t_exec_start) * 1e3
         now = time.perf_counter()
         for i, fut in enumerate(futs):
-            fut._resolve(value=host[i])
-            latency_ms = (now - fut.t_submit) * 1e3
+            # Per-phase latency decomposition (ISSUE 11), recorded on the
+            # future itself: where did this request's latency go?
+            # queue-wait (submit -> batch assembly), H2D, device-wait
+            # (parked in the in-flight handoff behind the previous
+            # micro-batch), execute, D2H, answer (slice + resolve), plus
+            # the pad overhead estimate (execute time bought for zero
+            # rows: execute_ms * pad/bucket).
             queue_ms = (t_assembled - fut.t_submit) * 1e3
+            latency_ms = (now - fut.t_submit) * 1e3
+            fut.phases = {
+                "request_id": fut.request_id,
+                "bucket": bucket,
+                "rows": n,
+                "pad_rows": pad,
+                "queue_wait_ms": round(queue_ms, 3),
+                "h2d_ms": round((t_h2d_done - t_assembled) * 1e3, 3),
+                "device_wait_ms": round(
+                    (t_exec_start - t_h2d_done) * 1e3, 3
+                ),
+                "execute_ms": round(execute_ms, 3),
+                "d2h_ms": round((t_d2h - t_exec) * 1e3, 3),
+                "answer_ms": round((now - t_d2h) * 1e3, 3),
+                "pad_overhead_ms": round(execute_ms * pad / bucket, 3),
+                "latency_ms": round(latency_ms, 3),
+            }
+            if degraded:
+                fut.phases["degraded"] = True
+            fut._resolve(value=host[i])
+            self.slo.observe(latency_ms, ok=True)
             trace.metrics.observe("serve_latency_ms", latency_ms)
             trace.metrics.observe("serve_queue_wait_ms", queue_ms)
+            trace.metrics.observe("serve_device_wait_ms",
+                                  fut.phases["device_wait_ms"])
+            trace.metrics.observe("serve_execute_ms", execute_ms)
             trace.metrics.inc("serve_requests")
             # One span per REQUEST carrying its phase breakdown — the
             # span itself is point-like on the executor lane; the real
             # intervals live on the serve.h2d/execute/d2h spans above.
             with trace.span("serve.request", cat="serve") as sp:
-                sp.set(
-                    bucket=bucket,
-                    queue_wait_ms=round(queue_ms, 3),
-                    execute_ms=round((t_exec - t_assembled) * 1e3, 3),
-                    d2h_ms=round((t_d2h - t_exec) * 1e3, 3),
-                    latency_ms=round(latency_ms, 3),
-                )
+                sp.set(**fut.phases)
 
     def _fail_futs(self, futs, error: BaseException) -> None:
+        now = time.perf_counter()
         for fut in futs:
             if not fut.done():
                 fut._resolve(error=error)
+                # A typed failure burns error budget like an SLO miss.
+                self.slo.observe((now - fut.t_submit) * 1e3, ok=False)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -1000,6 +1090,31 @@ def _percentile(sorted_vals: Sequence[float], q: float) -> float:
     return float(sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))])
 
 
+#: The request-lifecycle phases aggregated by :func:`phase_breakdown`.
+PHASE_KEYS = (
+    "queue_wait_ms", "h2d_ms", "device_wait_ms", "execute_ms", "d2h_ms",
+    "answer_ms", "pad_overhead_ms",
+)
+
+
+def phase_breakdown(phases: Sequence[dict]) -> dict:
+    """Aggregate per-request phase decompositions (``ServeFuture.phases``)
+    into mean/p99 per phase — the tf.data-style bottleneck attribution for
+    the request path (which phase to fix to move p99)."""
+    out: dict = {"requests": len(phases)}
+    for key in PHASE_KEYS:
+        vals = sorted(p[key] for p in phases if key in p)
+        if not vals:
+            continue
+        out[key] = {
+            "mean": round(sum(vals) / len(vals), 3),
+            "p99": round(_percentile(vals, 0.99), 3),
+        }
+    if phases:
+        out["degraded_requests"] = sum(1 for p in phases if p.get("degraded"))
+    return out
+
+
 def serve_bench(
     engine: ServingEngine,
     requests: np.ndarray,
@@ -1027,8 +1142,9 @@ def serve_bench(
     # eager oracle rounds differently.
     aot_oracle = None if engine.parity_ok else engine.infer(requests)
 
-    def drive(server: Server) -> tuple[float, list, np.ndarray]:
+    def drive(server: Server) -> tuple[float, list, np.ndarray, list]:
         lat: list = []
+        phases: list = []
         answers: list = [None] * requests.shape[0]
         errors: list = []
 
@@ -1038,6 +1154,8 @@ def serve_bench(
             def resolve(fut, i):
                 answers[i] = fut.result(timeout)
                 lat.append(fut.latency_seconds())
+                if fut.phases is not None:
+                    phases.append(fut.phases)
 
             try:
                 for i in range(cid, requests.shape[0], clients):
@@ -1061,11 +1179,12 @@ def serve_bench(
         wall = time.perf_counter() - t0
         if errors:
             raise errors[0]
-        return wall, lat, np.stack(answers)
+        return wall, lat, np.stack(answers), phases
 
     with Server(engine) as server:
-        wall, lat, answers = drive(server)
+        wall, lat, answers, phases = drive(server)
         stats = server.stats
+        slo = server.slo.summary()
     lat_ms = sorted(v * 1e3 for v in lat)
     record = {
         "engine": engine.record(),
@@ -1076,6 +1195,13 @@ def serve_bench(
         "p99_latency_ms": round(_percentile(lat_ms, 0.99), 3),
         "max_latency_ms": round(lat_ms[-1], 3) if lat_ms else 0.0,
         "batcher": stats.record(),
+        # Where the latency went (ISSUE 11): mean/p99 of every request's
+        # per-phase decomposition — queue-wait vs device-wait vs pad
+        # overhead separable at a glance.
+        "phase_breakdown": phase_breakdown(phases),
+        # The live SLO surface at bench end: rolling p50/p99/QPS and the
+        # error-budget burn rate against KEYSTONE_SERVE_SLO_MS.
+        "slo": slo,
         "predictions_bit_identical": bool(np.array_equal(answers, offline)),
     }
     if aot_oracle is not None:
@@ -1096,7 +1222,7 @@ def serve_bench(
             eager_flush=engine.config.eager_flush,
         )
         with Server(engine, config=un_cfg) as server:
-            u_wall, _u_lat, u_answers = drive(server)
+            u_wall, _u_lat, u_answers, _u_phases = drive(server)
         record["unbatched_qps"] = round(requests.shape[0] / u_wall, 2)
         record["batched_vs_unbatched_qps"] = round(
             record["qps"] / max(record["unbatched_qps"], 1e-9), 2

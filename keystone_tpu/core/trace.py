@@ -25,21 +25,32 @@ schema.  This module is that shared substrate:
   (``*.jsonl``).  Enable with ``KEYSTONE_TRACE=out.json`` (checked once at
   import; the file is written at process exit) or programmatically with
   :func:`enable` / a workload's ``--trace`` flag.
+* **Flight recorder** — a bounded ring of the most recent events that runs
+  even with tracing DISABLED (``KEYSTONE_FLIGHT_DEPTH``, 0 disables): a
+  fault that fires in an untraced production process still has its last
+  moments on record, and ``core.telemetry`` dumps the ring as a postmortem
+  JSON when a typed fault is counted.  The ring is a fixed-capacity deque
+  — old events fall off the back, retained memory is bounded and constant
+  once warm.
 
-Overhead discipline: the disabled path is a module-bool check returning a
-cached null object — the tier-1 suite asserts zero retained allocation
-growth, and the bench acceptance bound is < 2% on ``stage_ops`` with
-tracing off.  Enabled, each finished span is one dict append under a lock
-(bounded at :data:`MAX_EVENTS`; overflow is counted, never unbounded).
+Overhead discipline: with tracing AND the flight ring off the path is a
+module-state check returning a cached null object; with only the ring on,
+each finished span is one small dict append into a bounded deque (the
+tier-1 suite asserts no retained allocation growth once the ring is warm),
+and the bench acceptance bound is < 2% on ``stage_ops`` with tracing off.
+Enabled, each finished span is one dict append under a lock (bounded at
+:data:`MAX_EVENTS`; overflow is counted, never unbounded).
 """
 
 from __future__ import annotations
 
 import atexit
 import collections
+import contextlib
 import json
 import logging
 import os
+import tempfile
 import threading
 import time
 
@@ -49,12 +60,36 @@ _logger = logging.getLogger("keystone_tpu.trace")
 #: Chrome trace_event JSON viewable in Perfetto, "out.jsonl" for JSONL).
 TRACE_ENV = "KEYSTONE_TRACE"
 
+#: env var: flight-recorder ring depth (events retained with tracing off);
+#: ``0`` disables the ring entirely.
+FLIGHT_ENV = "KEYSTONE_FLIGHT_DEPTH"
+
+#: Default flight-ring depth: enough to hold the last few micro-batches of
+#: serving lifecycle events around a fault, small enough that the retained
+#: footprint (~a few hundred KB of dicts) is production-invisible.
+DEFAULT_FLIGHT_DEPTH = 512
+
 #: Hard cap on buffered events — a runaway span loop degrades to a counted
 #: drop (``metrics`` counter ``trace_events_dropped``, plus a drop field in
 #: both export formats), never unbounded RAM.
 MAX_EVENTS = 1_000_000
 
 _EPOCH = time.perf_counter()  # ts origin: microseconds since module import
+
+# getpid() is a real syscall on every call (Python does not cache it), and
+# on sandboxed kernels it measures ~10us — per EVENT that would dwarf the
+# event itself.  Cached once; refreshed after fork so a forked child's
+# events carry ITS pid.
+_PID = os.getpid()
+
+
+def _refresh_pid() -> None:
+    global _PID
+    _PID = os.getpid()
+
+
+if hasattr(os, "register_at_fork"):  # pragma: no branch
+    os.register_at_fork(after_in_child=_refresh_pid)
 
 _lock = threading.Lock()
 _events: list = []
@@ -66,8 +101,32 @@ _epoch = 0
 _enabled = False
 _path: str | None = None
 _tids: dict[int, int] = {}  # threading.get_ident() -> small sequential tid
+_tid_metas: dict[int, dict] = {}  # tid -> its thread_name metadata event
+_tids_in_buffer: set = set()  # tids whose metadata reached _events
 _tls = threading.local()  # per-thread span stack (nesting/parents)
 _atexit_registered = False
+
+# -- the always-on flight recorder ring.  Deliberately separate from the
+# trace buffer: it records even when tracing is disabled, it is bounded by
+# construction (deque maxlen — old events fall off), and it is never
+# exported unless a postmortem asks for it (core.telemetry).
+_flight_lock = threading.Lock()
+_flight: collections.deque | None = None
+
+
+def _parse_flight_depth() -> int:
+    raw = os.environ.get(FLIGHT_ENV, "").strip()
+    if not raw:
+        return DEFAULT_FLIGHT_DEPTH
+    try:
+        depth = int(raw)
+    except ValueError:
+        _logger.error(
+            "%s=%r is not an integer — flight recorder at default depth %d",
+            FLIGHT_ENV, raw, DEFAULT_FLIGHT_DEPTH,
+        )
+        return DEFAULT_FLIGHT_DEPTH
+    return max(0, depth)
 
 
 def _now_us() -> float:
@@ -80,20 +139,30 @@ def _tid() -> int:
     ident = threading.get_ident()
     tid = _tids.get(ident)
     if tid is None:
+        meta = None
         with _lock:
             tid = _tids.get(ident)
             if tid is None:
                 tid = len(_tids)
                 _tids[ident] = tid
-                _events.append(
-                    {
-                        "ph": "M",
-                        "name": "thread_name",
-                        "pid": os.getpid(),
-                        "tid": tid,
-                        "args": {"name": threading.current_thread().name},
-                    }
-                )
+                meta = {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": _PID,
+                    "tid": tid,
+                    "args": {"name": threading.current_thread().name},
+                }
+                # Cached even when tracing is off: a thread first seen in
+                # flight-only mode must still get its Perfetto lane label
+                # if tracing is enabled later (enable() re-emits these).
+                _tid_metas[tid] = meta
+                if _enabled:
+                    _events.append(meta)
+                    _tids_in_buffer.add(tid)
+        if meta is not None and _flight is not None:
+            with _flight_lock:
+                if _flight is not None:
+                    _flight.append(meta)
     return tid
 
 
@@ -118,6 +187,17 @@ def _record(event: dict) -> None:
         # truncation shows up in every metrics snapshot, not just the
         # exporters' drop fields.
         metrics.inc("trace_events_dropped")
+
+
+def _emit(event: dict) -> None:
+    """Route one finished event: into the flight ring (always, when the
+    ring is on) and into the trace buffer (only when tracing is enabled)."""
+    if _flight is not None:
+        with _flight_lock:
+            if _flight is not None:
+                _flight.append(event)
+    if _enabled:
+        _record(event)
 
 
 class _NullSpan:
@@ -196,14 +276,14 @@ class Span:
                 # Typed-error spans are never silent: the failure rides in
                 # the span itself, matchable against the fault counters.
                 args["error"] = etype.__name__
-        _record(
+        _emit(
             {
                 "ph": "X",
                 "name": self.name,
                 "cat": self.cat,
                 "ts": self.t0,
                 "dur": max(t1 - self.t0, 0.0),
-                "pid": os.getpid(),
+                "pid": _PID,
                 "tid": self._tid,
                 "args": args,
             }
@@ -227,9 +307,11 @@ class Span:
 
 
 def span(name: str, cat: str = "span", **attrs):
-    """Open a structured span.  Disabled tracing returns a shared no-op —
-    the hot-path cost is one module-bool check."""
-    if not _enabled:
+    """Open a structured span.  With tracing AND the flight ring both off
+    this returns a shared no-op — the hot-path cost is two module-state
+    checks; with only the flight ring on, the finished span lands in the
+    bounded ring and nowhere else."""
+    if not _enabled and _flight is None:
         return _NULL
     return Span(name, cat, attrs)
 
@@ -253,7 +335,7 @@ class _IOSpan(Span):
 def io_span(name: str, nbytes: int, cat: str = "io", **attrs):
     """Span for an IO/IPC transfer of ``nbytes`` — like :func:`span`, plus
     achieved-bandwidth accounting (``bytes`` + ``mb_per_s`` attrs)."""
-    if not _enabled:
+    if not _enabled and _flight is None:
         return _NULL
     attrs["bytes"] = int(nbytes)
     return _IOSpan(name, cat, attrs)
@@ -288,7 +370,7 @@ def plan_span(
     """Span for a placement-plan choice: like :func:`span`, plus
     predicted-vs-measured cost accounting (``predicted_s`` /
     ``measured_s`` / ``prediction_error`` attrs)."""
-    if not _enabled:
+    if not _enabled and _flight is None:
         return _NULL
     if predicted_seconds is not None:
         attrs["predicted_s"] = round(float(predicted_seconds), 6)
@@ -308,16 +390,16 @@ def instant(name: str, **attrs) -> None:
     chaos verifier's counted-fault -> trace-event pairing stays
     consistent.  A span, by contrast, opened before the reset would carry
     a stale tid/interval, which is why Span.__exit__ drops it."""
-    if not _enabled:
+    if not _enabled and _flight is None:
         return
-    _record(
+    _emit(
         {
             "ph": "i",
             "s": "t",
             "name": name,
             "cat": "instant",
             "ts": _now_us(),
-            "pid": os.getpid(),
+            "pid": _PID,
             "tid": _tid(),
             "args": attrs,
         }
@@ -343,6 +425,13 @@ def enable(path: str) -> None:
     with _lock:
         _path = path
         _enabled = True
+        # Threads first registered while tracing was off (flight-only
+        # mode) have cached thread_name metas — emit them now so their
+        # lanes are labeled in the flushed trace.
+        for tid, meta in _tid_metas.items():
+            if tid not in _tids_in_buffer:
+                _events.append(meta)
+                _tids_in_buffer.add(tid)
         if not _atexit_registered:
             atexit.register(_flush_at_exit)
             _atexit_registered = True
@@ -356,16 +445,19 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Drop every buffered event (test isolation; per-schedule traces).
-    Spans still open when reset is called belong to the OLD buffer and are
-    discarded at their exit (epoch check), never recorded into the new
-    one."""
+    """Drop every buffered event AND the flight ring (test isolation;
+    per-schedule traces).  Spans still open when reset is called belong to
+    the OLD buffer and are discarded at their exit (epoch check), never
+    recorded into the new one."""
     global _dropped, _epoch
     with _lock:
         _events.clear()
         _tids.clear()
+        _tid_metas.clear()
+        _tids_in_buffer.clear()
         _dropped = 0
         _epoch += 1
+    flight_reset()
 
 
 def events() -> list:
@@ -374,28 +466,87 @@ def events() -> list:
         return list(_events)
 
 
+# -- flight recorder ----------------------------------------------------------
+
+
+def flight_depth() -> int:
+    """Current flight-ring capacity (0 = disabled)."""
+    with _flight_lock:
+        return _flight.maxlen if _flight is not None else 0
+
+
+def set_flight_depth(depth: int) -> None:
+    """Resize the flight ring to ``depth`` events (0 disables it).  The
+    most recent events that still fit are kept."""
+    global _flight
+    with _flight_lock:
+        if depth <= 0:
+            _flight = None
+            return
+        kept = list(_flight)[-depth:] if _flight is not None else []
+        _flight = collections.deque(kept, maxlen=int(depth))
+
+
+def flight_events() -> list:
+    """Snapshot (copy) of the flight ring, oldest first."""
+    with _flight_lock:
+        return list(_flight) if _flight is not None else []
+
+
+def flight_reset() -> None:
+    """Drop the flight ring's contents (capacity unchanged)."""
+    with _flight_lock:
+        if _flight is not None:
+            _flight.clear()
+
+
+def atomic_write(path: str, write) -> None:
+    """Crash-safe text-file write (the ``core.checkpoint`` idiom, shared
+    by the trace flush and the telemetry exporters): ``write(f)`` runs on
+    a same-directory temp file which is fsynced and atomically renamed
+    into place — a crash mid-write leaves the previous file intact; a
+    failed write unlinks its temp.  The result gets world-readable 0644
+    perms (mkstemp's private 0600 would hide exported metrics/traces from
+    scraper users)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            write(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.chmod(tmp, 0o644)
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
 def flush(path: str | None = None) -> str | None:
     """Write the buffered events to ``path`` (default: the enabled path).
     Chrome format for ``*.json``, JSONL for ``*.jsonl``.  Returns the
-    path written, or None when there is nowhere to write."""
+    path written, or None when there is nowhere to write.  Crash-safe via
+    :func:`atomic_write` — never a truncated Perfetto JSON."""
     path = path or _path
     if path is None:
         return None
     with _lock:
         evs = list(_events)
         dropped = _dropped
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
+
+    def write(f) -> None:
         if path.endswith(".jsonl"):
             for ev in evs:
                 f.write(json.dumps(ev) + "\n")
             if dropped:
-                # Truncation must be visible in THIS format too, not just
-                # the Chrome JSON's otherData field.
+                # Truncation must be visible in THIS format too, not
+                # just the Chrome JSON's otherData field.
                 f.write(
                     json.dumps(
                         {"ph": "M", "name": "dropped_events",
-                         "pid": os.getpid(), "tid": 0,
+                         "pid": _PID, "tid": 0,
                          "args": {"count": dropped}}
                     ) + "\n"
                 )
@@ -411,7 +562,8 @@ def flush(path: str | None = None) -> str | None:
                 },
                 f,
             )
-    os.replace(tmp, path)
+
+    atomic_write(path, write)
     return path
 
 
@@ -551,6 +703,10 @@ metrics = Metrics()
 
 
 # -- env activation -----------------------------------------------------------
+
+# The flight recorder is ON by default (the whole point is postmortems for
+# faults nobody predicted); KEYSTONE_FLIGHT_DEPTH=0 turns it off.
+set_flight_depth(_parse_flight_depth())
 
 _env_path = os.environ.get(TRACE_ENV, "").strip()
 if _env_path:

@@ -119,6 +119,7 @@ def serve_fitted(
             answers = np.stack([f.result(timeout) for f in futs])
             lat_ms = sorted(f.latency_seconds() * 1e3 for f in futs)
             stats = server.stats.record()
+            slo = server.slo.summary()
         wall = time.perf_counter() - t0
         record["served"] = {
             "requests": int(requests.shape[0]),
@@ -126,6 +127,13 @@ def serve_fitted(
             "p50_latency_ms": round(kserve._percentile(lat_ms, 0.50), 3),
             "p99_latency_ms": round(kserve._percentile(lat_ms, 0.99), 3),
             "batcher": stats,
+            # Per-phase latency decomposition + the live SLO surface
+            # (ISSUE 11) — the smoke path reports the same telemetry
+            # shape as the full --serveBench record.
+            "phase_breakdown": kserve.phase_breakdown(
+                [f.phases for f in futs if f.phases is not None]
+            ),
+            "slo": slo,
             "predictions_bit_identical": bool(
                 np.array_equal(answers, offline)
             ),

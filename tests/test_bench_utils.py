@@ -1,9 +1,21 @@
 """Unit tests for bench.py's measurement scaffolding (the parts that guard
-the round artifact — no TPU required)."""
+the round artifact — no TPU required), and the bench regression
+observatory (tools/bench_diff.py) exercised over the checked-in
+BENCH_r01–r05 round records so the observatory itself runs in tier-1
+without hardware."""
 
 import json
+import os
+import sys
+
+import pytest
 
 import bench
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import bench_diff  # noqa: E402  (tools/bench_diff.py)
 
 
 def test_error_record_shape():
@@ -86,6 +98,129 @@ def test_solve_at_scale_records_fit_report_per_attempt(monkeypatch):
         assert rep["placement"]["candidates"]
         assert rep["placement"]["ranking"]
     json.dumps(out)  # the whole probe record must stay JSON-able
+
+
+# -- the regression observatory (tools/bench_diff.py, ISSUE 11) ---------------
+
+
+def _round(n: int) -> str:
+    return os.path.join(_REPO, f"BENCH_r{n:02d}.json")
+
+
+def test_bench_diff_r04_vs_r05_emits_machine_verdict(capsys):
+    """The ISSUE 11 acceptance pair: r05's driver artifact was truncated
+    (``parsed: null``), so the diff must emit an INCOMPARABLE verdict as
+    machine-readable JSON — naming the problem — instead of crashing."""
+    rc = bench_diff.main([_round(4), _round(5)])
+    assert rc == 2
+    first_line = capsys.readouterr().out.splitlines()[0]
+    record = json.loads(first_line)
+    assert record["metric"] == "bench_diff"
+    assert record["verdict"] == "incomparable"
+    assert record["compared"] == 0
+    assert "null" in record["problems"]["cand"]
+
+
+def test_bench_diff_r03_vs_r04_is_comparable_and_clean(capsys):
+    """r03 -> r04 is the real improvement round (featurize 497k -> 1.19M
+    images/sec/chip): comparable, no regressions, improvements named."""
+    rc = bench_diff.main([_round(3), _round(4)])
+    assert rc == 0
+    record = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert record["verdict"] == "ok"
+    assert record["compared"] >= 3
+    assert record["regressions"] == []
+    improved = {r["metric"] for r in record["improvements"]}
+    assert "value" in improved
+
+
+def test_bench_diff_every_checked_in_pair_yields_a_verdict():
+    """The observatory over the whole round history: every consecutive
+    pair produces a structurally-valid verdict (r05's truncated record
+    degrades to incomparable, never a crash)."""
+    rounds = bench_diff.list_rounds(_REPO)
+    assert [n for n, _ in rounds] == [1, 2, 3, 4, 5]
+    for (n_a, p_a), (n_b, p_b) in zip(rounds, rounds[1:]):
+        record = bench_diff.diff_files(p_a, p_b)
+        assert record["verdict"] in ("ok", "regressed", "incomparable"), (
+            n_a, n_b, record,
+        )
+        json.dumps(record)  # machine-readable throughout
+        if n_b == 5:
+            assert record["verdict"] == "incomparable"
+        else:
+            assert record["compared"] >= 1, (n_a, n_b)
+
+
+def test_bench_diff_detects_regression_and_direction():
+    base = {
+        "metric": "m", "value": 100.0, "solve_seconds": 1.0,
+        "extra_metrics": {"serving": {"mnist_fft": {
+            "qps": 50.0, "p99_latency_ms": 10.0,
+        }}},
+    }
+    # value collapsed far past its 15% threshold -> regressed
+    worse = json.loads(json.dumps(base))
+    worse["value"] = 50.0
+    out = bench_diff.compare(base, worse)
+    assert out["verdict"] == "regressed"
+    assert [r["metric"] for r in out["regressions"]] == ["value"]
+    # lower-is-better: p99 doubling regresses, halving improves
+    slower = json.loads(json.dumps(base))
+    slower["extra_metrics"]["serving"]["mnist_fft"]["p99_latency_ms"] = 30.0
+    out = bench_diff.compare(base, slower)
+    assert any(
+        r["metric"].endswith("p99_latency_ms") for r in out["regressions"]
+    )
+    faster = json.loads(json.dumps(base))
+    faster["extra_metrics"]["serving"]["mnist_fft"]["p99_latency_ms"] = 2.0
+    out = bench_diff.compare(base, faster)
+    assert out["verdict"] == "ok"
+    assert any(
+        r["metric"].endswith("p99_latency_ms") for r in out["improvements"]
+    )
+
+
+def test_bench_diff_metric_overrides():
+    metrics = bench_diff.parse_metric_overrides(
+        ["value=0.01", "custom.path=0.2:lower"]
+    )
+    table = {p: (d, t) for p, d, t in metrics}
+    assert table["value"] == ("higher", 0.01)
+    assert table["custom.path"] == ("lower", 0.2)
+    with pytest.raises(ValueError):
+        bench_diff.parse_metric_overrides(["nonsense"])
+    with pytest.raises(ValueError):
+        bench_diff.parse_metric_overrides(["a=0.1:sideways"])
+
+
+def test_latest_usable_round_skips_truncated_r05():
+    found = bench_diff.latest_usable_round(_REPO)
+    assert found is not None
+    num, path, record = found
+    assert num == 4  # r05 is parsed:null — the newest USABLE round is r04
+    assert record["metric"] == "random_patch_cifar_featurize"
+
+
+def test_bench_self_compare_section(tmp_path):
+    """bench.py's in-round observatory: the record self-compares against
+    the newest usable prior round and embeds the verdict."""
+    base = {"metric": "m", "value": 100.0, "unit": "u"}
+    with open(tmp_path / "BENCH_r01.json", "w") as f:
+        json.dump({"parsed": base}, f)
+    with open(tmp_path / "BENCH_r02.json", "w") as f:
+        json.dump({"parsed": None}, f)  # truncated newest -> falls back
+    out = bench.bench_self_diff({"metric": "m", "value": 95.0}, str(tmp_path))
+    assert out["baseline"] == "BENCH_r01.json"
+    assert out["baseline_round"] == 1
+    assert out["verdict"] == "ok"
+    regressed = bench.bench_self_diff(
+        {"metric": "m", "value": 10.0}, str(tmp_path)
+    )
+    assert regressed["verdict"] == "regressed"
+    # no prior rounds at all -> an honest note, not a crash
+    empty = bench.bench_self_diff({"metric": "m"}, str(tmp_path / "void"))
+    assert "note" in empty
 
 
 def test_solve_at_scale_success_records_searched_plan(monkeypatch):

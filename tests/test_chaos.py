@@ -48,7 +48,20 @@ def _check(r):
 
 @pytest.mark.parametrize("seed", chaos.TIER1_SEEDS)
 def test_chaos_schedule_mnist(seed, tmp_path):
-    _check(chaos.run_schedule(seed, "mnist", tmpdir=str(tmp_path)))
+    """Every tier-1 schedule runs TRACED and its trace is held to the
+    never-silent bar (the ``chaos_run.py --trace`` invariant, extended
+    from the original 10 families to all 17): every counted fault appears
+    as a kind-tagged ``fault`` instant, every typed error as a failed
+    span or fault event."""
+    trace_path = str(tmp_path / f"chaos_seed{seed}.json")
+    r = chaos.run_schedule(
+        seed, "mnist", tmpdir=str(tmp_path), trace_path=trace_path
+    )
+    _check(r)
+    violations = chaos.verify_trace(trace_path, r)
+    assert violations == [], {
+        "seed": seed, "family": r.fault.kind, "violations": violations,
+    }
 
 
 @pytest.mark.parametrize("seed", (0, 4))  # OOM step-down + NaN guard

@@ -330,6 +330,27 @@ class TestDeadline:
         assert exc.value.phase == "inner"  # where execution was
         assert exc.value.seconds == pytest.approx(0.3, abs=0.1)
 
+    def test_outer_deadline_bounds_a_hung_recovery_handler(self):
+        """An `except DeadlineExceeded:` suite that itself hangs must
+        still be bounded by the enclosing deadline: the unwind-race
+        postponement is recency-bounded, so only an error raised moments
+        ago defers the outer trip — a hung recovery path does not get
+        postponed forever."""
+        import time
+
+        from keystone_tpu.core.resilience import DeadlineExceeded, deadline
+
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded) as exc:
+            with deadline(0.6, phase="outer"):
+                try:
+                    with deadline(0.1, phase="inner"):
+                        time.sleep(30.0)
+                except DeadlineExceeded:
+                    time.sleep(30.0)  # the hung recovery path
+        assert exc.value.phase == "outer"
+        assert time.monotonic() - t0 < 5.0
+
     def test_nonpositive_budget_rejected(self):
         from keystone_tpu.core.resilience import deadline
 

@@ -152,15 +152,25 @@ def test_span_error_attribute_recorded(tmp_path):
 
 
 def test_disabled_mode_no_allocation_growth():
+    """With tracing off, retained memory attributable to the trace module
+    must be CONSTANT once the flight-recorder ring is warm: disabled
+    spans/instants buffer nothing into the trace event list, and the ring
+    is bounded by construction — old events fall off as new ones land, so
+    5000 further spans retain no net growth."""
     assert not trace.enabled()
-    for _ in range(100):  # warm any lazy state
-        with trace.span("warm", k=1):
-            pass
-        trace.instant("warm")
-    gc.collect()
+    assert trace.flight_depth() > 0  # the always-on ring is the default
     filters = [tracemalloc.Filter(True, trace.__file__)]
     tracemalloc.start()
     try:
+        # Warm past the ring's capacity INSIDE the traced window so the
+        # before-snapshot sees it full of TRACKED entries — from here on,
+        # every append evicts one (this is the boundedness claim).
+        warm = trace.flight_depth() + 200
+        for _ in range(warm):
+            with trace.span("warm", k=1):
+                pass
+            trace.instant("warm", n=1)
+        gc.collect()
         before = tracemalloc.take_snapshot().filter_traces(filters)
         for _ in range(5000):
             with trace.span("hot"):
@@ -172,14 +182,103 @@ def test_disabled_mode_no_allocation_growth():
         tracemalloc.stop()
     size_before = sum(s.size for s in before.statistics("filename"))
     size_after = sum(s.size for s in after.statistics("filename"))
-    # Disabled spans/instants must RETAIN nothing: no event buffering, no
-    # growth attributable to the trace module (4 KB slack for allocator
-    # bookkeeping noise).
-    assert size_after - size_before < 4096, (
+    # No net retained growth attributable to the trace module (8 KB slack
+    # for allocator bookkeeping / dict-churn noise in the full ring).
+    assert size_after - size_before < 8192, (
         f"disabled tracing retained {size_after - size_before} bytes "
-        "across 5000 spans"
+        "across 5000 spans (flight ring unbounded, or events buffered?)"
     )
     assert trace.events() == []
+    assert len(trace.flight_events()) <= trace.flight_depth()
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_ring_records_with_tracing_disabled():
+    assert not trace.enabled()
+    with trace.span("flight_probe", cat="t", bytes=4):
+        pass
+    trace.instant("flight_point", n=2)
+    # nothing buffered for export...
+    assert trace.events() == []
+    # ...but the ring has the last moments, span attrs included
+    names = {e["name"]: e for e in trace.flight_events()}
+    assert "flight_probe" in names and "flight_point" in names
+    assert names["flight_probe"]["args"]["bytes"] == 4
+    assert names["flight_probe"]["ph"] == "X"
+    assert names["flight_point"]["ph"] == "i"
+
+
+def test_flight_ring_is_bounded_and_resizable():
+    prev = trace.flight_depth()
+    try:
+        trace.set_flight_depth(8)
+        for i in range(50):
+            trace.instant("ring_fill", i=i)
+        evs = trace.flight_events()
+        assert len(evs) <= 8
+        # the ring keeps the MOST RECENT events
+        assert evs[-1]["args"]["i"] == 49
+        trace.set_flight_depth(0)
+        trace.instant("ring_off")
+        assert trace.flight_events() == []
+    finally:
+        trace.set_flight_depth(prev)
+
+
+def test_flight_ring_rides_along_when_tracing_enabled(tmp_path):
+    path = _trace_to(tmp_path)
+    with trace.span("both_worlds"):
+        pass
+    trace.flush(path)
+    assert any(e["name"] == "both_worlds" for e in trace.flight_events())
+    assert any(
+        e["name"] == "both_worlds"
+        for e in trace_view.load_events(path)
+    )
+
+
+def test_thread_seen_in_flight_mode_gets_named_on_enable(tmp_path):
+    """A thread first registered while tracing was OFF (flight-only mode)
+    must still get its thread_name metadata when tracing is enabled later
+    — lanes in the flushed trace stay labeled."""
+    assert not trace.enabled()
+    done = threading.Event()
+
+    def worker():
+        with trace.span("pre_enable_span"):
+            pass
+        done.set()
+
+    t = threading.Thread(target=worker, name="flight-first-thread")
+    t.start()
+    t.join()
+    assert done.is_set()
+    path = _trace_to(tmp_path)
+    with trace.span("post_enable"):
+        pass
+    trace.flush(path)
+    metas = [
+        ev for ev in trace_view.load_events(path)
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name"
+    ]
+    assert any(
+        m["args"]["name"] == "flight-first-thread" for m in metas
+    ), metas
+
+
+def test_fault_counter_lands_in_flight_ring_untraced():
+    # The chaos postmortem path: a counted fault must be in the ring even
+    # when tracing was never enabled.
+    assert not trace.enabled()
+    counters.record("flight_fault_probe", "ring check")
+    faults = [
+        e for e in trace.flight_events()
+        if e.get("name") == "fault"
+        and e.get("args", {}).get("kind") == "flight_fault_probe"
+    ]
+    assert faults, "counted fault missing from the flight ring"
 
 
 # -- exporters ----------------------------------------------------------------
@@ -220,6 +319,32 @@ def test_perfetto_chrome_trace_schema(tmp_path):
         if ev["ph"] == "i" and ev["name"] == "fault"
     }
     assert "trace_test_fault" in kinds
+
+
+def test_flush_is_crash_safe_atomic(tmp_path, monkeypatch):
+    """The checkpoint atomic-write idiom on trace.flush: a failure mid-
+    write must leave the previously-flushed trace intact and no temp
+    litter — never a truncated Perfetto JSON."""
+    path = _trace_to(tmp_path)
+    with trace.span("survivor"):
+        pass
+    trace.flush(path)
+    good = open(path).read()
+    json.loads(good)  # valid JSON on disk
+
+    def exploding_dump(*a, **kw):
+        raise RuntimeError("injected crash mid-flush")
+
+    monkeypatch.setattr(trace.json, "dump", exploding_dump)
+    with trace.span("doomed_flush"):
+        pass
+    with pytest.raises(RuntimeError, match="mid-flush"):
+        trace.flush(path)
+    monkeypatch.undo()
+    # the old trace survived byte-for-byte and no temp files remain
+    assert open(path).read() == good
+    leftovers = [p for p in os.listdir(tmp_path) if ".tmp" in p]
+    assert leftovers == [], leftovers
 
 
 def test_jsonl_export(tmp_path):
@@ -416,6 +541,31 @@ def test_ingest_overlap_from_spans_matches_bench_methodology(
     )
     # decode-bound stream: overlap should be high by construction
     assert trace_eff > 0.8
+
+
+def test_early_stopped_stream_leaves_no_suspended_span(tmp_path, monkeypatch):
+    """A consumer that abandons a stream mid-iteration must not leave the
+    generator-hosted ingest.consume span suspended on this thread's span
+    stack (it would corrupt every later span's depth/parent and the
+    flight recorder's view): Stream.close() closes the drain generator,
+    the span exits as aborted, the stack returns to its prior depth."""
+    img = np.zeros((40, 40, 3), np.float32)
+    monkeypatch.setattr(image_loaders, "decode_image", lambda data: img)
+    tar = _sleepy_tar(tmp_path, 8)
+    depth_before = len(trace._stack())
+    with ingest.stream_batches(tar, 2, num_threads=1, transfer=False) as st:
+        for _b in st:
+            break  # abandon the stream mid-iteration
+    assert st.join(10.0)
+    assert len(trace._stack()) == depth_before, [
+        s.name for s in trace._stack()
+    ]
+    aborted = [
+        e for e in trace.flight_events()
+        if e.get("name") == "ingest.consume"
+        and e.get("args", {}).get("aborted")
+    ]
+    assert aborted, "abandoned consume span did not record its abort"
 
 
 def test_ingest_producer_span_records_stats(tmp_path, monkeypatch):

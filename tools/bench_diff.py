@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Bench regression observatory: diff two bench rounds (``BENCH_r*.json``)
+with per-metric thresholds and a machine-readable verdict.
+
+Each bench round is one JSON record (the first stdout line of ``bench.py``,
+usually stored wrapped by the driver as ``{"parsed": <record>, "tail": ...}``).
+This tool compares a curated set of throughput/latency/efficiency metrics
+between a BASE round and a CANDIDATE round and judges each against a
+relative threshold in its good direction — a ``higher``-is-better metric
+regresses when ``cand < base * (1 - threshold)``; a ``lower``-is-better
+metric when ``cand > base * (1 + threshold)``.  Thresholds default to the
+observed run-to-run spread of the shared tunneled bench chip (~10-15%)
+plus margin; override any metric with ``--metric``.
+
+The first stdout line is the machine-readable JSON verdict (the bench.py
+truncation-proof convention); human-readable lines follow.  Exit status:
+0 = ok (no regressions), 1 = regression(s), 2 = incomparable (a record is
+missing/unparsed — BENCH_r05's truncated ``parsed: null`` is the canonical
+case — or no metric exists in both rounds).
+
+Usage:
+    python tools/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_diff.py BENCH_r03.json BENCH_r04.json \
+        --metric value=0.10 --metric extra_metrics.jpeg_decode.speedup=0.5
+
+``bench.py`` runs the same comparison in-process at the end of every round
+(the ``bench_diff`` section of its record) against the newest usable prior
+round, so the observatory rides along on hardware rounds automatically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: (dotted path, good direction, relative threshold).  Curated rather than
+#: exhaustive: these are the metrics whose movement means something across
+#: rounds; everything else in the record is context, not a pass/fail bar.
+DEFAULT_METRICS: tuple = (
+    ("value", "higher", 0.15),
+    ("mfu", "higher", 0.15),
+    ("solve_seconds", "lower", 0.30),
+    ("solve_device_seconds", "lower", 0.30),
+    ("extra_metrics.imagenet_fv_featurize.value", "higher", 0.20),
+    ("extra_metrics.imagenet_fv_featurize.mfu", "higher", 0.20),
+    ("extra_metrics.jpeg_decode.serial_images_per_sec", "higher", 0.25),
+    ("extra_metrics.jpeg_decode.threaded_images_per_sec", "higher", 0.25),
+    (
+        "extra_metrics.jpeg_decode.snapshot.warm_read_images_per_sec",
+        "higher", 0.30,
+    ),
+    ("extra_metrics.e2e.cifar.e2e_images_per_sec", "higher", 0.25),
+    ("extra_metrics.e2e.cifar.overlap_efficiency", "higher", 0.15),
+    ("extra_metrics.e2e.imagenet_fv.e2e_images_per_sec", "higher", 0.25),
+    ("extra_metrics.e2e.imagenet_fv.overlap_efficiency", "higher", 0.15),
+    ("extra_metrics.optimizer.auto_cache.speedup", "higher", 0.30),
+    ("extra_metrics.optimizer.autotune.speedup", "higher", 0.30),
+    ("extra_metrics.serving.mnist_fft.qps", "higher", 0.30),
+    ("extra_metrics.serving.mnist_fft.p99_latency_ms", "lower", 0.50),
+    (
+        "extra_metrics.serving.mnist_fft.batched_vs_unbatched_qps",
+        "higher", 0.30,
+    ),
+    ("extra_metrics.serving.cifar_conv.qps", "higher", 0.30),
+    ("extra_metrics.serving.cifar_conv.p99_latency_ms", "lower", 0.50),
+    ("extra_metrics.solve_at_scale.examples_per_sec", "higher", 0.30),
+    ("extra_metrics.placement.max_search_overhead_frac", "lower", 1.00),
+)
+
+
+def get_path(record: dict, dotted: str):
+    """Numeric leaf at ``dotted`` path, or None (missing / non-numeric)."""
+    node = record
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    if isinstance(node, bool) or not isinstance(node, (int, float)):
+        return None
+    return float(node)
+
+
+def load_round(path: str) -> tuple[dict | None, str | None]:
+    """(bench record, problem).  Unwraps the driver's ``{"parsed": ...}``
+    envelope; a missing file, unparsable JSON, or a null/recordless parse
+    (the BENCH_r05 truncation) returns ``(None, reason)``."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        return None, f"unreadable: {e}"
+    except json.JSONDecodeError as e:
+        return None, f"invalid JSON: {e}"
+    record = doc.get("parsed", doc) if isinstance(doc, dict) else doc
+    if record is None:
+        return None, "record is null (truncated round artifact — no parsed bench line)"
+    if not isinstance(record, dict) or "metric" not in record:
+        return None, "not a bench record (no 'metric' key)"
+    return record, None
+
+
+def compare(
+    base: dict,
+    cand: dict,
+    metrics=DEFAULT_METRICS,
+) -> dict:
+    """Diff two bench records metric-by-metric.  Returns the verdict dict
+    (``verdict``: ok | regressed | incomparable, plus per-metric rows)."""
+    rows = []
+    regressions = []
+    improvements = []
+    for path, direction, threshold in metrics:
+        b, c = get_path(base, path), get_path(cand, path)
+        if b is None or c is None:
+            continue
+        if b == 0:
+            continue  # a zero base makes the ratio meaningless
+        ratio = c / b
+        if direction == "higher":
+            regressed = ratio < 1.0 - threshold
+            improved = ratio > 1.0 + threshold
+        else:
+            regressed = ratio > 1.0 + threshold
+            improved = ratio < 1.0 - threshold
+        status = (
+            "regressed" if regressed else "improved" if improved else "ok"
+        )
+        row = {
+            "metric": path,
+            "direction": direction,
+            "threshold": threshold,
+            "base": b,
+            "cand": c,
+            "ratio": round(ratio, 4),
+            "status": status,
+        }
+        rows.append(row)
+        if regressed:
+            regressions.append(row)
+        elif improved:
+            improvements.append(row)
+    verdict = (
+        "incomparable"
+        if not rows
+        else "regressed" if regressions else "ok"
+    )
+    return {
+        "verdict": verdict,
+        "compared": len(rows),
+        "regressions": regressions,
+        "improvements": improvements,
+        "rows": rows,
+    }
+
+
+def diff_files(base_path: str, cand_path: str, metrics=DEFAULT_METRICS) -> dict:
+    """File-level wrapper: load both rounds, compare, and fold any load
+    problem into an ``incomparable`` verdict instead of crashing — a
+    truncated round is a finding, not a tool failure."""
+    base, base_problem = load_round(base_path)
+    cand, cand_problem = load_round(cand_path)
+    record = {
+        "metric": "bench_diff",
+        "base": os.path.basename(base_path),
+        "cand": os.path.basename(cand_path),
+    }
+    problems = {}
+    if base_problem:
+        problems["base"] = base_problem
+    if cand_problem:
+        problems["cand"] = cand_problem
+    if problems:
+        record.update(
+            verdict="incomparable", compared=0,
+            regressions=[], improvements=[], rows=[], problems=problems,
+        )
+        return record
+    record.update(compare(base, cand, metrics=metrics))
+    return record
+
+
+def list_rounds(dirpath: str) -> list[tuple[int, str]]:
+    """(round number, path) of every BENCH_r*.json, ascending."""
+    out = []
+    for path in glob.glob(os.path.join(dirpath, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def latest_usable_round(dirpath: str) -> tuple[int, str, dict] | None:
+    """The newest round whose record actually parses (a truncated newest
+    round — BENCH_r05 — falls back to the one before it)."""
+    for num, path in reversed(list_rounds(dirpath)):
+        record, problem = load_round(path)
+        if record is not None:
+            return num, path, record
+    return None
+
+
+def parse_metric_overrides(specs: list[str], metrics=DEFAULT_METRICS):
+    """``--metric path=threshold[:higher|lower]`` entries merged over the
+    default metric set (an unknown path is ADDED, default direction
+    ``higher``)."""
+    table = {path: (direction, thr) for path, direction, thr in metrics}
+    for spec in specs:
+        path, _, rest = spec.partition("=")
+        if not rest:
+            raise ValueError(
+                f"--metric {spec!r}: expected path=threshold[:direction]"
+            )
+        thr_s, _, direction = rest.partition(":")
+        thr = float(thr_s)
+        if direction and direction not in ("higher", "lower"):
+            raise ValueError(
+                f"--metric {spec!r}: direction must be higher|lower"
+            )
+        prev_dir = table.get(path, ("higher", None))[0]
+        table[path] = (direction or prev_dir, thr)
+    return tuple((p, d, t) for p, (d, t) in table.items())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("bench_diff")
+    p.add_argument("base", help="base round (BENCH_rNN.json or raw record)")
+    p.add_argument("cand", help="candidate round to judge against base")
+    p.add_argument(
+        "--metric",
+        action="append",
+        default=[],
+        metavar="PATH=THRESH[:DIR]",
+        help="override/add a metric threshold, e.g. value=0.10 or "
+        "extra_metrics.serving.mnist_fft.p99_latency_ms=0.3:lower",
+    )
+    a = p.parse_args(argv)
+    metrics = parse_metric_overrides(a.metric)
+    record = diff_files(a.base, a.cand, metrics=metrics)
+    # Machine-readable verdict FIRST, flushed (the bench.py convention) —
+    # any tail window that reaches the end has the whole JSON line.
+    print(json.dumps(record), flush=True)
+    if record.get("problems"):
+        for side, why in record["problems"].items():
+            print(f"# {side} {record[side]}: {why}")
+    for row in record["rows"]:
+        mark = {"regressed": "BAD", "improved": "+++", "ok": "ok "}[row["status"]]
+        print(
+            f"# {mark} {row['metric']}: {row['base']:g} -> {row['cand']:g} "
+            f"(x{row['ratio']}, {row['direction']} better, "
+            f"threshold {row['threshold']})"
+        )
+    print(
+        f"# bench_diff {record['base']} -> {record['cand']}: "
+        f"{record['verdict']} ({record['compared']} metric(s) compared, "
+        f"{len(record['regressions'])} regression(s), "
+        f"{len(record['improvements'])} improvement(s))"
+    )
+    return {"ok": 0, "regressed": 1, "incomparable": 2}[record["verdict"]]
+
+
+if __name__ == "__main__":
+    sys.exit(main())
