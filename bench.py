@@ -1820,6 +1820,99 @@ def bench_serving(rng):
             ),
             "target_frac": 0.02,
         }
+
+        # -- the wire front-end (ISSUE 12) --------------------------------
+        # The SAME two warm engines behind a ShapeRouter + WireServer,
+        # driven over real localhost sockets by concurrent clients — the
+        # headline serving.wire_p99_ms and the router's own route
+        # overhead (serving.router_route_overhead_us) are what
+        # tools/bench_diff.py regresses on across rounds.
+        import sys as _sys
+        import threading as _threading
+
+        from keystone_tpu.core import frontend as kfrontend
+        from keystone_tpu.core import trace as _ktrace
+        from keystone_tpu.core import wire as kwire
+
+        _tools = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"
+        )
+        if _tools not in _sys.path:
+            _sys.path.insert(0, _tools)
+        from serve_client import drive as wire_drive
+
+        wire_reqs = {
+            "mnist_fft": x[:128],
+            "cifar_conv": imgs[:64].astype(np.float32),
+        }
+        router = kfrontend.ShapeRouter(label="bench_router")
+        try:
+            router.add_engine(engines["mnist_fft"])
+            router.add_engine(engines["cifar_conv"])
+            lat_all: list = []
+            per_engine: dict = {}
+            errors: list = []
+            lock = _threading.Lock()
+            with kwire.WireServer(router, port=0, label="bench") as ws:
+
+                def wire_client(label, reqs):
+                    try:
+                        with kwire.WireClient(port=ws.port, timeout=60.0) as c:
+                            rec = wire_drive(
+                                c, list(reqs), window=8, timeout=120.0
+                            )
+                        with lock:
+                            lats = rec.pop("latencies_ms")
+                            lat_all.extend(lats)
+                            per_engine.setdefault(label, []).extend(lats)
+                    except BaseException as e:  # noqa: BLE001 — recorded
+                        errors.append(f"{label}: {type(e).__name__}: {e}")
+
+                ts = [
+                    _threading.Thread(target=wire_client, args=(lbl, reqs))
+                    for lbl, reqs in wire_reqs.items()
+                    for _ in range(2)  # two concurrent clients per shape
+                ]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(300.0)
+                wall = time.perf_counter() - t0
+                ws_record = ws.record()
+            lat_all.sort()
+            pick = lambda q: round(  # noqa: E731
+                lat_all[min(len(lat_all) - 1, int(q * len(lat_all)))], 3
+            ) if lat_all else 0.0
+            overhead = _ktrace.metrics.snapshot()["histograms"].get(
+                "router_route_overhead_us", {}
+            )
+            out["wire"] = {
+                "requests": len(lat_all),
+                "wall_seconds": round(wall, 3),
+                "qps": round(len(lat_all) / wall, 2) if wall > 0 else 0.0,
+                "per_shape": {
+                    lbl: {
+                        "requests": len(v),
+                        "p50_ms": round(sorted(v)[len(v) // 2], 3),
+                        "p99_ms": round(
+                            sorted(v)[min(len(v) - 1, int(0.99 * len(v)))], 3
+                        ),
+                    }
+                    for lbl, v in per_engine.items()
+                    if v
+                },
+                "server": ws_record,
+                "router": router.record(),
+                "errors": errors,
+            }
+            out["wire_p50_ms"] = pick(0.50)
+            out["wire_p99_ms"] = pick(0.99)
+            out["router_route_overhead_us"] = round(
+                float(overhead.get("p99", 0.0)), 3
+            )
+        finally:
+            router.close()
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     return out
@@ -2074,12 +2167,26 @@ def main():
         print(f"# serving: {srv['error'][:120]}")
     else:
         for wk, r in srv.items():
+            if not isinstance(r, dict):
+                continue  # scalar headline metrics (wire_p99_ms, ...)
             if wk == "telemetry_overhead":
                 print(
                     f"# serving telemetry overhead: p99 {r['p99_off_ms']}ms "
                     f"off -> {r['p99_on_ms']}ms on "
                     f"({r['p99_overhead_frac']:+.2%}, target < "
                     f"{r['target_frac']:.0%})"
+                )
+                continue
+            if wk == "wire":
+                rt = r["router"]["stats"]
+                print(
+                    f"# serving wire: {r['requests']} requests over real "
+                    f"sockets, p50 {srv.get('wire_p50_ms')}ms / p99 "
+                    f"{srv.get('wire_p99_ms')}ms, {r['qps']} QPS, route "
+                    f"overhead p99 "
+                    f"{srv.get('router_route_overhead_us')}us, "
+                    f"{rt['routes']} routed / {rt['retires']} retire(s)"
+                    + (f", ERRORS {r['errors']}" if r["errors"] else "")
                 )
                 continue
             burn = r.get("slo", {}).get("window", {}).get("burn_rate")

@@ -661,6 +661,7 @@ class ServerStats:
 
     requests: int = 0
     answered: int = 0
+    failed: int = 0  #: futures resolved with a typed error (close, OOM floor)
     malformed: int = 0
     batches: int = 0
     flush_full: int = 0  #: flushes triggered by a full largest bucket
@@ -712,6 +713,11 @@ class Server:
         self._queue: list = []  # pending _Request entries, arrival order
         self._cond = threading.Condition()
         self._stopped = False
+        # Futures minted but not yet resolved (answered OR failed): the
+        # accounting drain()/outstanding() wait on.  Every resolution path
+        # decrements exactly once (the success loop in _run_batch, and
+        # _fail_futs for every typed-failure path).
+        self._outstanding = 0
         # assembler -> executor handoff (bounded: backpressure keeps at
         # most INFLIGHT_BATCHES transfers ahead of the executor).
         self._inflight: list = []
@@ -745,6 +751,7 @@ class Server:
             self._next_id += 1
             fut = ServeFuture(request_id=self._next_id)
             self._queue.append((arr, fut))
+            self._outstanding += 1
             self.stats.requests += 1
             self.stats.queue_peak = max(self.stats.queue_peak, len(self._queue))
             trace.metrics.gauge("serve_queue_depth", len(self._queue))
@@ -897,8 +904,14 @@ class Server:
                         ):
                             self._inflight_cond.wait(_POLL_SECONDS)
                         if self._stopped:
+                            # Close raced a collected batch: fail the chunk
+                            # in hand AND every not-yet-chunked future of
+                            # this batch — all_futs[i:] would otherwise
+                            # never be resolved by anyone (the queue no
+                            # longer holds them), leaving their callers
+                            # blocked forever.
                             self._fail_futs(
-                                futs,
+                                futs + all_futs[i:],
                                 ServingUnavailable("server closed mid-batch"),
                             )
                             stop = True
@@ -1036,14 +1049,24 @@ class Server:
             # intervals live on the serve.h2d/execute/d2h spans above.
             with trace.span("serve.request", cat="serve") as sp:
                 sp.set(**fut.phases)
+        with self._cond:
+            self._outstanding -= n
+            self._cond.notify_all()
 
     def _fail_futs(self, futs, error: BaseException) -> None:
         now = time.perf_counter()
+        resolved = 0
         for fut in futs:
             if not fut.done():
                 fut._resolve(error=error)
+                resolved += 1
                 # A typed failure burns error budget like an SLO miss.
                 self.slo.observe((now - fut.t_submit) * 1e3, ok=False)
+        if resolved:
+            with self._cond:
+                self.stats.failed += resolved
+                self._outstanding -= resolved
+                self._cond.notify_all()
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -1059,6 +1082,26 @@ class Server:
             "server closed with requests still pending"
         )
         self._fail_futs([fut for _, fut in pending], err)
+
+    def outstanding(self) -> int:
+        """Futures minted by :meth:`submit` and not yet resolved (answered
+        or typed-failed)."""
+        with self._cond:
+            return self._outstanding
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every submitted request has been RESOLVED (answered
+        or typed-failed) — the graceful-retire primitive: a router stops
+        routing to this server, drains it, then closes it, so an engine
+        swap never drops a request.  Returns False on timeout."""
+        end = time.monotonic() + timeout
+        with self._cond:
+            while self._outstanding > 0:
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, _POLL_SECONDS))
+        return True
 
     def close(self) -> None:
         """Stop accepting requests; pending/in-flight requests answer

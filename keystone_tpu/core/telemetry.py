@@ -259,6 +259,14 @@ def register_slo(label: str, **kwargs) -> SLOTracker:
     return tracker
 
 
+def unregister_slo(label: str) -> None:
+    """Drop ``label``'s tracker from the live SLO surface (a retired
+    serving engine must stop being exported — its history belongs to the
+    records that captured it, not to every future snapshot)."""
+    with _slo_lock:
+        _slo_trackers.pop(label, None)
+
+
 def slo_summaries() -> dict:
     with _slo_lock:
         trackers = list(_slo_trackers.values())

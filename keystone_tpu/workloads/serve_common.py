@@ -6,10 +6,13 @@ modes through here:
 
 * ``--serve`` — warm-load the ``--pipelineFile`` artifact into a
   :class:`~..core.serve.ServingEngine` (cold start measured: checkpoint
-  restore, per-bucket AOT compile, warmup), stand up the dynamic-batching
-  :class:`~..core.serve.Server`, answer every request through the online
-  path, and assert the answers BIT-EQUAL the offline ``pipeline(x)`` — the
-  smoke proof that the endpoint serves the same model it loaded.
+  restore, per-bucket AOT compile, warmup), register it with a
+  :class:`~..core.frontend.ShapeRouter` (the production front-end tier —
+  ISSUE 12: every workload endpoint is shape-routed, so the serving record
+  carries router stats: engines, routes, retires), answer every request
+  through the routed online path, and assert the answers BIT-EQUAL the
+  offline ``pipeline(x)`` — the smoke proof that the endpoint serves the
+  same model it loaded.
 * ``--serveBench`` — the SLO bench: N concurrent synthetic clients with
   pipelined depth drive the same endpoint; p50/p99 latency, sustained QPS,
   batcher occupancy, and the batched-vs-unbatched QPS ratio land in
@@ -112,14 +115,24 @@ def serve_fitted(
     else:
         import time
 
+        from ..core import frontend as kfrontend
+
         offline = engine.offline(requests)
         t0 = time.perf_counter()
-        with kserve.Server(engine) as server:
-            futs = [server.submit(r) for r in requests]
+        # The single-engine demo path rides the SAME front-end tier a
+        # multi-shape deployment uses: the engine registers with a
+        # ShapeRouter and every request is routed by shape, so the
+        # serving record proves the router out on every workload (and
+        # carries its stats alongside the phase breakdown).
+        with kfrontend.ShapeRouter(label=f"{label}_router") as router:
+            key = router.add_engine(engine)
+            server = router.server_for(key)
+            futs = [router.submit(r) for r in requests]
             answers = np.stack([f.result(timeout) for f in futs])
             lat_ms = sorted(f.latency_seconds() * 1e3 for f in futs)
             stats = server.stats.record()
             slo = server.slo.summary()
+            router_record = router.record()
         wall = time.perf_counter() - t0
         record["served"] = {
             "requests": int(requests.shape[0]),
@@ -133,6 +146,9 @@ def serve_fitted(
             "phase_breakdown": kserve.phase_breakdown(
                 [f.phases for f in futs if f.phases is not None]
             ),
+            # The front-end tier's view of the same traffic (ISSUE 12):
+            # live engines, routes, warm adds, retires, admission ledger.
+            "router": router_record,
             "slo": slo,
             "predictions_bit_identical": bool(
                 np.array_equal(answers, offline)

@@ -77,6 +77,17 @@ draws its parameters — fully deterministic):
   ``autoshard_stepdown`` to the next-ranked plan, and predictions must
   stay bit-equal to the fault-free MESH run — a mispredicted sharded
   layout degrades loudly, never silently.
+* ``wire_disconnect`` — a wire client vanishes MID-BATCH (socket closed
+  with requests in flight, core.wire + core.frontend): the disconnect is
+  counted (``wire_client_disconnect``), the micro-batches its requests
+  ride in still COMPLETE (every serve future resolves — batchmates are
+  never poisoned, answers for the dead client are discarded), and a
+  second client on the same endpoint gets every answer bit-equal.
+* ``slow_loris`` — clients trickle PARTIAL frames and stall (half a
+  length prefix; a declared payload with one byte sent): each parks only
+  its own connection's reader — the accept loop keeps accepting, and
+  concurrent well-behaved clients get every answer bit-equal and timely,
+  never starved behind the stalled parser.
 """
 
 from __future__ import annotations
@@ -135,16 +146,24 @@ FAMILIES = (
     "serve_burst_oom",
     "plan_mispredict",
     "spec_mispredict",
+    "wire_disconnect",
+    "slow_loris",
 )
 
-#: The serving-path families (core.serve), selectable via
-#: ``tools/chaos_run.py --serve``.
-SERVE_FAMILIES = ("slow_client", "malformed_request", "serve_burst_oom")
+#: The serving-path families (core.serve / core.frontend / core.wire),
+#: selectable via ``tools/chaos_run.py --serve``.
+SERVE_FAMILIES = (
+    "slow_client",
+    "malformed_request",
+    "serve_burst_oom",
+    "wire_disconnect",
+    "slow_loris",
+)
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(17))
-FULL_SEEDS = tuple(range(34))
+TIER1_SEEDS = tuple(range(19))
+FULL_SEEDS = tuple(range(38))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -283,6 +302,17 @@ def make_schedule(seed: int) -> Fault:
         return Fault(kind, {"failures": 1})
     if kind == "spec_mispredict":
         return Fault(kind, {"failures": 1})
+    if kind == "wire_disconnect":
+        return Fault(
+            kind,
+            {"requests": int(rng.integers(6, 13)), "hold_seconds": 0.25},
+        )
+    if kind == "slow_loris":
+        return Fault(
+            kind,
+            {"requests": int(rng.integers(6, 13)),
+             "lorises": int(rng.integers(1, 3))},
+        )
     return Fault("deadline", {"seconds": 1.0})
 
 
@@ -1038,6 +1068,154 @@ def _serve_burst_oom_phase(fault: Fault, tmpdir: str, seed: int) -> None:
         )
 
 
+def _wire_disconnect_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """A wire client vanishes mid-batch: the disconnect must be COUNTED
+    (``wire_client_disconnect``), every request it submitted must still
+    ride its micro-batch to completion (futures resolve; batchmates are
+    never poisoned), and a concurrent surviving client must get every
+    answer bit-equal to the offline apply."""
+    from keystone_tpu.core import frontend as kfrontend
+    from keystone_tpu.core import wire as kwire
+
+    rng = np.random.default_rng(seed)
+    engine = _serve_engine()
+    n = int(fault.params["requests"])
+    hold = float(fault.params["hold_seconds"])
+    reqs_a = _serve_requests(rng, n)
+    reqs_b = _serve_requests(rng, n)
+    real_execute = engine._execute
+
+    def slow_execute(bucket, dev_batch):
+        # Stretch the batch so the disconnect demonstrably lands while
+        # requests are IN FLIGHT (EOF with a full window, not after it).
+        time.sleep(hold)
+        return real_execute(bucket, dev_batch)
+
+    before = counters.get("wire_client_disconnect")
+    router = kfrontend.ShapeRouter(label=f"chaos_wire_{seed}")
+    server_ref = None
+    try:
+        key = router.add_engine(engine)
+        server_ref = router.server_for(key)
+        engine._execute = slow_execute
+        with kwire.WireServer(router, port=0, label="chaos") as ws:
+            victim = kwire.WireClient(port=ws.port)
+            for r in reqs_a:
+                victim.submit(r)
+            victim.close()  # mid-batch: the first micro-batch is still held
+            with kwire.WireClient(port=ws.port) as survivor:
+                answers = np.stack(
+                    survivor.predict_many(list(reqs_b), window=8, timeout=60.0)
+                )
+        engine._execute = real_execute
+        if not server_ref.drain(30.0):
+            raise ChaosOracleError(
+                "serve futures did not drain after the disconnect — the "
+                "victim's batch never completed"
+            )
+    finally:
+        engine._execute = real_execute
+        router.close()
+    if counters.get("wire_client_disconnect") - before < 1:
+        raise ChaosOracleError(
+            "a client vanished with requests in flight but no "
+            "wire_client_disconnect was counted"
+        )
+    if not np.array_equal(answers, engine.offline(reqs_b)):
+        raise ChaosOracleError(
+            "the surviving client's answers differ from the offline apply "
+            "— a dead batchmate changed RESULTS, not just who gets bytes"
+        )
+    st = server_ref.stats
+    if st.answered != 2 * n or st.failed != 0:
+        raise ChaosOracleError(
+            f"batch completion broke under the disconnect: answered "
+            f"{st.answered} / failed {st.failed}, expected {2 * n} / 0"
+        )
+
+
+def _slow_loris_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """Slow-loris clients trickle partial frames and stall: each must park
+    only its OWN connection's reader — the accept loop keeps accepting and
+    concurrent honest clients are answered bit-equal and timely."""
+    import socket as _socket
+    import threading
+
+    from keystone_tpu.core import frontend as kfrontend
+    from keystone_tpu.core import wire as kwire
+
+    rng = np.random.default_rng(seed)
+    engine = _serve_engine()
+    n = int(fault.params["requests"])
+    lorises = int(fault.params["lorises"])
+    reqs = [_serve_requests(rng, n), _serve_requests(rng, n)]
+    answers: dict = {}
+    errors: list = []
+
+    router = kfrontend.ShapeRouter(label=f"chaos_loris_{seed}")
+    try:
+        router.add_engine(engine)
+        with kwire.WireServer(router, port=0, label="chaos") as ws:
+            stuck = []
+            for i in range(lorises):
+                s = _socket.create_connection(("127.0.0.1", ws.port), 5.0)
+                if i % 2 == 0:
+                    s.sendall(b"\x00\x00")  # half a length prefix
+                else:
+                    # a declared 64-byte payload with ONE byte delivered
+                    s.sendall(kwire._LEN.pack(64) + b"\x01")
+                stuck.append(s)
+            time.sleep(0.1)  # the loris frames reach the readers first
+
+            def good_client(cid):
+                try:
+                    with kwire.WireClient(port=ws.port) as c:
+                        answers[cid] = np.stack(
+                            c.predict_many(
+                                list(reqs[cid]), window=8, timeout=30.0
+                            )
+                        )
+                except BaseException as e:  # noqa: BLE001 — surfaced below
+                    errors.append(e)
+
+            t0 = time.monotonic()
+            ts = [
+                threading.Thread(target=good_client, args=(c,))
+                for c in range(2)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60.0)
+            elapsed = time.monotonic() - t0
+            # The accept loop must still be accepting WHILE the lorises
+            # hold their sockets open mid-frame.
+            with kwire.WireClient(port=ws.port) as probe:
+                probe.ping()
+            for s in stuck:
+                s.close()
+    finally:
+        router.close()
+    if errors:
+        raise errors[0]
+    if elapsed > 30.0:
+        raise ChaosOracleError(
+            f"honest clients took {elapsed:.1f}s behind {lorises} "
+            "slow-loris connection(s) — partial frames starved the service"
+        )
+    for cid in range(2):
+        if not np.array_equal(answers[cid], engine.offline(reqs[cid])):
+            raise ChaosOracleError(
+                f"client {cid}'s answers differ from the offline apply "
+                "under slow-loris load"
+            )
+    counters.record(
+        "chaos_slow_loris",
+        f"seed {seed}: {lorises} stalled partial-frame connection(s), "
+        f"2x{n} honest requests answered bit-equal in {elapsed:.2f}s",
+    )
+
+
 def _stepdown_oracle(
     res: dict,
     stepdown_delta: int,
@@ -1138,6 +1316,14 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "serve_burst_oom":
         _serve_burst_oom_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "wire_disconnect":
+        _wire_disconnect_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "slow_loris":
+        _slow_loris_phase(fault, tmpdir, seed)
         return _run_workload(workload)
 
     if fault.kind == "plan_mispredict":

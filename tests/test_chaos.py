@@ -33,6 +33,8 @@ EXPECTED_COUNTER = {
     "serve_burst_oom": "serve_burst_oom",
     "plan_mispredict": "autoshard_stepdown",
     "spec_mispredict": "autoshard_stepdown",
+    "wire_disconnect": "wire_client_disconnect",
+    "slow_loris": "chaos_slow_loris",
 }
 
 
@@ -50,7 +52,7 @@ def _check(r):
 def test_chaos_schedule_mnist(seed, tmp_path):
     """Every tier-1 schedule runs TRACED and its trace is held to the
     never-silent bar (the ``chaos_run.py --trace`` invariant, extended
-    from the original 10 families to all 17): every counted fault appears
+    from the original 10 families to all 19): every counted fault appears
     as a kind-tagged ``fault`` instant, every typed error as a failed
     span or fault event."""
     trace_path = str(tmp_path / f"chaos_seed{seed}.json")
@@ -96,6 +98,11 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # (GSPMD-layout) top plan must step down counted and stay bit-equal
     # to the fault-free mesh run
     assert "spec_mispredict" in kinds
+    # Wire-protocol coverage (ISSUE 12): a client disconnect mid-batch
+    # must be counted with the batch still completing, and slow-loris
+    # partial frames must never stall the accept loop or starve honest
+    # connections
+    assert {"wire_disconnect", "slow_loris"} <= kinds
 
 
 def test_schedules_are_deterministic():

@@ -321,6 +321,68 @@ class TestServer:
         with pytest.raises(TimeoutError):
             fut.result(0.01)
 
+    def test_close_racing_submit_resolves_every_future(self, engine, rng):
+        """ISSUE 12 regression: a close() racing in-flight submits from a
+        second thread must fail every accepted future typed
+        (ServingUnavailable) — never leave one unresolved forever.  The
+        slowed execute keeps collected multi-chunk batches in the
+        assembler's hands when close lands, the historical leak (the
+        not-yet-chunked tail of a collected batch was failed by nobody)."""
+        real = engine._execute
+
+        def slow(bucket, dev):
+            time.sleep(0.05)
+            return real(bucket, dev)
+
+        engine._execute = slow
+        try:
+            for round_ in range(3):
+                server = kserve.Server(engine)
+                futs: list = []
+                stop_submitting = threading.Event()
+
+                def submitter():
+                    reqs = _requests(rng, 64)
+                    for r in reqs:
+                        if stop_submitting.is_set():
+                            return
+                        try:
+                            futs.append(server.submit(r))
+                        except kserve.ServingUnavailable:
+                            return  # closed — the typed post-close answer
+
+                threads = [
+                    threading.Thread(target=submitter) for _ in range(2)
+                ]
+                for t in threads:
+                    t.start()
+                time.sleep(0.02 * (round_ + 1))  # vary where close lands
+                server.close()
+                assert server.join(10.0), "server threads leaked after close"
+                stop_submitting.set()
+                for t in threads:
+                    t.join(10.0)
+                for f in futs:
+                    try:
+                        # Every ACCEPTED submit resolves: an answer or the
+                        # typed close error — a hang here is the bug.
+                        f.result(5.0)
+                    except kserve.ServingUnavailable:
+                        pass
+                assert server.outstanding() == 0
+                st = server.stats
+                assert st.answered + st.failed == st.requests == len(futs)
+        finally:
+            engine._execute = real
+
+    def test_drain_waits_for_all_outstanding(self, engine, rng):
+        with kserve.Server(engine) as server:
+            futs = [server.submit(r) for r in _requests(rng, 16)]
+            assert server.drain(30.0)
+            assert server.outstanding() == 0
+            assert all(f.done() for f in futs)
+            assert server.stats.answered == 16
+
 
 # -- SLO bench + observability ------------------------------------------------
 
@@ -494,3 +556,39 @@ class TestColdStart:
         )
         assert res.returncode == 0, res.stderr[-2000:]
         assert "FRESH_SERVE_OK" in res.stdout
+
+
+# -- the workload serving glue (serve_common) ---------------------------------
+
+
+class TestServeFitted:
+    def test_demo_path_routes_through_shape_router(self, tmp_path, rng):
+        """ISSUE 12 satellite: the workload --serve demo path rides the
+        ShapeRouter front-end, and the serving record carries router stats
+        (engines, routes, retires) alongside the phase breakdown."""
+        from keystone_tpu.core.checkpoint import save_pipeline
+        from keystone_tpu.workloads.serve_common import serve_fitted
+
+        pipe, x = _fitted_servable(rng)
+        stem = str(tmp_path / "routed_servable")
+        save_pipeline(stem, pipe)
+        record = serve_fitted(
+            stem,
+            jax.ShapeDtypeStruct((12,), np.float32),
+            x[:24],
+            label="routed",
+        )
+        served = record["served"]
+        healthy = served["predictions_bit_identical"] or served.get(
+            "predictions_deterministic", False
+        )
+        assert healthy
+        router = served["router"]
+        json.dumps(router)  # JSON-able for results["serving"]
+        assert router["stats"]["routes"] == 24
+        assert router["stats"]["retires"] == 0
+        assert router["stats"]["misses"] == 0
+        assert len(router["engines"]) == 1
+        (eng_rec,) = router["engines"].values()
+        assert eng_rec["label"] == "routed"
+        assert served["batcher"]["answered"] == 24

@@ -1,0 +1,337 @@
+"""Wire-protocol tests (core.wire): framing roundtrips, the socket
+server/client pair end to end, per-client backpressure windows, typed
+errors over the wire, mid-batch disconnects, and slow-loris immunity."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_frontend import _make_engine, _reqs
+
+from keystone_tpu.core import frontend, wire
+from keystone_tpu.core import serve as kserve
+from keystone_tpu.core.resilience import counters
+
+pytestmark = pytest.mark.serve
+
+
+# -- framing ------------------------------------------------------------------
+
+
+class TestFraming:
+    @pytest.mark.parametrize(
+        "arr",
+        [
+            np.arange(12, dtype=np.float32).reshape(3, 4),
+            np.arange(8, dtype=np.float64),
+            np.arange(6, dtype=np.int32).reshape(1, 2, 3),
+            np.array(3.5, dtype=np.float32),  # rank 0
+            np.zeros((0, 4), np.uint8),  # empty
+            np.array([True, False, True]),
+        ],
+    )
+    def test_array_roundtrip_bit_exact(self, arr):
+        out = wire.decode_array(wire.encode_array(arr))
+        assert out.dtype == arr.dtype
+        assert out.shape == arr.shape
+        assert np.array_equal(out, arr)
+
+    def test_object_dtype_rejected(self):
+        with pytest.raises(wire.WireProtocolError, match="object"):
+            wire.encode_array(np.array(["x"], dtype=object))
+
+    def test_size_mismatch_rejected(self):
+        body = bytearray(wire.encode_array(np.zeros(4, np.float32)))
+        with pytest.raises(wire.WireProtocolError, match="declares"):
+            wire.decode_array(bytes(body[:-2]))
+
+    def test_frame_extract_handles_partials_byte_by_byte(self):
+        arr = np.arange(5, dtype=np.float32)
+        frame = wire.encode_frame(
+            wire.T_REQUEST, 77, wire.encode_array(arr)
+        )
+        buf = bytearray()
+        out = None
+        for byte in frame:
+            buf.append(byte)
+            got = wire.extract_frame(buf, wire.max_frame_bytes())
+            if got is not None:
+                out = got
+        assert out is not None
+        ftype, rid, body = out
+        assert (ftype, rid) == (wire.T_REQUEST, 77)
+        assert np.array_equal(wire.decode_array(body), arr)
+        assert not buf  # fully consumed
+
+    def test_two_frames_in_one_buffer(self):
+        f1 = wire.encode_frame(wire.T_PING, 1)
+        f2 = wire.encode_frame(wire.T_PING, 2)
+        buf = bytearray(f1 + f2)
+        assert wire.extract_frame(buf, 2**20)[1] == 1
+        assert wire.extract_frame(buf, 2**20)[1] == 2
+        assert wire.extract_frame(buf, 2**20) is None
+
+    def test_oversized_and_runt_and_bad_version_rejected(self):
+        buf = bytearray(wire._LEN.pack(2**30) + b"xxxx")
+        with pytest.raises(wire.WireProtocolError, match="cap"):
+            wire.extract_frame(buf, wire.max_frame_bytes())
+        buf = bytearray(wire._LEN.pack(2) + b"xx")
+        with pytest.raises(wire.WireProtocolError, match="runt"):
+            wire.extract_frame(buf, 2**20)
+        payload = wire._HEAD.pack(9, wire.T_PING, 1)
+        buf = bytearray(wire._LEN.pack(len(payload)) + payload)
+        with pytest.raises(wire.WireProtocolError, match="version"):
+            wire.extract_frame(buf, 2**20)
+
+    def test_error_and_retry_roundtrip(self):
+        _, _, body = wire.extract_frame(
+            bytearray(wire.encode_error(5, "MalformedRequest", "bad µ")),
+            2**20,
+        )
+        assert wire.decode_error(body) == ("MalformedRequest", "bad µ")
+        _, _, body = wire.extract_frame(
+            bytearray(wire.encode_retry_after(6, 0.25, "window full")),
+            2**20,
+        )
+        assert wire.decode_retry_after(body) == (0.25, "window full")
+
+
+# -- a stalling target (no jax needed) ----------------------------------------
+
+
+class _StallTarget:
+    """Accepts every submit, resolves nothing until told — the in-flight
+    window fills deterministically."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.futs: list = []
+
+    def submit(self, arr):
+        fut = kserve.ServeFuture()
+        with self.lock:
+            self.futs.append((fut, np.asarray(arr)))
+        return fut
+
+    def release_all(self):
+        with self.lock:
+            futs, self.futs = self.futs, []
+        for fut, arr in futs:
+            fut._resolve(value=arr * 2.0)
+
+
+# -- the socket server/client pair --------------------------------------------
+
+
+class TestWireServer:
+    def test_end_to_end_bit_equal_multi_shape(self, rng):
+        e16, e8 = _make_engine((16,)), _make_engine((8,))
+        with frontend.ShapeRouter(label="wiretest") as router:
+            router.add_engine(e16)
+            router.add_engine(e8)
+            with wire.WireServer(router, port=0) as ws:
+                with wire.WireClient(port=ws.port) as client:
+                    assert client.ping() < 5.0
+                    r16 = _reqs(rng, 12, (16,))
+                    r8 = _reqs(rng, 5, (8,))
+                    a16 = np.stack(client.predict_many(list(r16), window=4))
+                    a8 = np.stack(client.predict_many(list(r8), window=4))
+                assert np.array_equal(a16, e16.offline(r16))
+                assert np.array_equal(a8, e8.offline(r8))
+                rec = ws.record()
+                assert rec["stats"]["requests"] >= 17
+                assert rec["stats"]["responses"] >= 17
+                assert rec["stats"]["protocol_errors"] == 0
+
+    def test_two_concurrent_clients_fair_and_bit_equal(self, rng):
+        e16 = _make_engine((16,))
+        results: dict = {}
+        errors: list = []
+        with frontend.ShapeRouter(label="wirefair") as router:
+            router.add_engine(e16)
+            with wire.WireServer(router, port=0, max_inflight=4) as ws:
+
+                def client(cid, reqs):
+                    try:
+                        with wire.WireClient(port=ws.port) as c:
+                            results[cid] = np.stack(
+                                c.predict_many(list(reqs), window=8)
+                            )
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+
+                r0, r1 = _reqs(rng, 20, (16,)), _reqs(rng, 20, (16,))
+                ts = [
+                    threading.Thread(target=client, args=(0, r0)),
+                    threading.Thread(target=client, args=(1, r1)),
+                ]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(60.0)
+                assert not errors, errors
+                assert np.array_equal(results[0], e16.offline(r0))
+                assert np.array_equal(results[1], e16.offline(r1))
+                # window 8 > max_inflight 4: the flood was pushed back at
+                # least once and the clients retried their way through.
+                assert ws.stats.retry_after >= 1
+
+    def test_inflight_window_pushes_back_retry_after(self):
+        target = _StallTarget()
+        with wire.WireServer(target, port=0, max_inflight=2) as ws:
+            client = wire.WireClient(port=ws.port, timeout=10.0)
+            try:
+                for _ in range(5):
+                    client.submit(np.zeros(4, np.float32))
+                retries = 0
+                for _ in range(3):
+                    reply = client.read()
+                    assert reply.type == wire.T_RETRY_AFTER
+                    assert reply.retry_after_s > 0
+                    retries += 1
+                assert retries == 3  # window 2 held, 3 pushed back
+                target.release_all()
+                got = {client.read().request_id for _ in range(2)}
+                assert got == {1, 2}  # the two admitted requests answered
+            finally:
+                client.close()
+            assert ws.stats.retry_after == 3
+
+    def test_typed_errors_cross_the_wire(self, rng):
+        e16 = _make_engine((16,))
+        with frontend.ShapeRouter(label="wireerr") as router:
+            router.add_engine(e16)
+            with wire.WireServer(router, port=0) as ws:
+                with wire.WireClient(port=ws.port) as client:
+                    # wrong shape, no factory -> NoRouteForShape over ERROR
+                    with pytest.raises(wire.WireRemoteError) as ei:
+                        client.predict(np.zeros(5, np.float32))
+                    assert ei.value.etype == "NoRouteForShape"
+                    bad = _reqs(rng, 1, (16,))[0]
+                    bad[0] = np.nan
+                    with pytest.raises(wire.WireRemoteError) as ei:
+                        client.predict(bad)
+                    assert ei.value.etype == "MalformedRequest"
+                    # the connection survives typed errors
+                    ok = _reqs(rng, 1, (16,))[0]
+                    assert np.array_equal(
+                        client.predict(ok), e16.offline(ok[None])[0]
+                    )
+                assert ws.stats.errors >= 2
+
+    def test_router_backpressure_maps_to_retry_after(self, rng):
+        cfg = frontend.RouterConfig(warm_threshold=2, retry_after_s=0.01)
+        router = frontend.ShapeRouter(
+            _make_engine, label="wirewarm", config=cfg
+        )
+        try:
+            with wire.WireServer(router, port=0) as ws:
+                with wire.WireClient(port=ws.port) as client:
+                    req = _reqs(rng, 1, (8,))[0]
+                    out = client.predict(req, timeout=60.0)
+                    assert out is not None
+                    assert ws.stats.retry_after >= 1  # the cold-shape pushback
+            assert router.stats.warm_adds == 1
+        finally:
+            router.close()
+
+    def test_client_disconnect_mid_batch_counted_batch_completes(self):
+        target = _StallTarget()
+        before = counters.get("wire_client_disconnect")
+        with wire.WireServer(target, port=0, max_inflight=8) as ws:
+            # Client A submits and vanishes with requests in flight.
+            a = wire.WireClient(port=ws.port)
+            for _ in range(3):
+                a.submit(np.ones(4, np.float32))
+            deadline = time.monotonic() + 10.0
+            while len(target.futs) < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(target.futs) == 3
+            a.close()
+            deadline = time.monotonic() + 10.0
+            while (
+                ws.stats.mid_batch_disconnects < 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert ws.stats.mid_batch_disconnects == 1
+            assert counters.get("wire_client_disconnect") == before + 1
+            # The batch still completes (futures resolve) and a live
+            # client keeps being served.
+            target.release_all()
+            with wire.WireClient(port=ws.port) as b:
+                b.submit(np.full(4, 3.0, np.float32))
+                deadline = time.monotonic() + 10.0
+                while not target.futs and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                target.release_all()
+                reply = b.read()
+                assert reply.type == wire.T_RESPONSE
+                assert np.array_equal(
+                    reply.value, np.full(4, 6.0, np.float32)
+                )
+
+    def test_slow_loris_partial_frame_starves_nobody(self):
+        target = _StallTarget()
+        with wire.WireServer(target, port=0) as ws:
+            loris = socket.create_connection(("127.0.0.1", ws.port), 5.0)
+            try:
+                # Half a length prefix, then silence: the reader parks on
+                # ITS buffer; the accept loop and other clients must not.
+                loris.sendall(b"\x00\x00")
+                time.sleep(0.1)
+                t0 = time.monotonic()
+                with wire.WireClient(port=ws.port) as c:
+                    c.submit(np.ones(4, np.float32))
+                    deadline = time.monotonic() + 10.0
+                    while not target.futs and time.monotonic() < deadline:
+                        time.sleep(0.01)
+                    target.release_all()
+                    reply = c.read()
+                    assert reply.type == wire.T_RESPONSE
+                assert time.monotonic() - t0 < 5.0
+            finally:
+                loris.close()
+
+    def test_protocol_violation_answers_error_and_closes(self):
+        target = _StallTarget()
+        with wire.WireServer(target, port=0) as ws:
+            sock = socket.create_connection(("127.0.0.1", ws.port), 5.0)
+            try:
+                sock.sendall(wire._LEN.pack(2**31) + b"garbage")
+                sock.settimeout(5.0)
+                buf = bytearray()
+                while True:
+                    frame = wire.extract_frame(buf, 2**20)
+                    if frame is not None:
+                        break
+                    chunk = sock.recv(4096)
+                    assert chunk, "connection closed with no ERROR frame"
+                    buf.extend(chunk)
+                ftype, _rid, body = frame
+                assert ftype == wire.T_ERROR
+                assert wire.decode_error(body)[0] == "WireProtocolError"
+                # ... and the connection dies (violators lose their parser)
+                deadline = time.monotonic() + 5.0
+                closed = False
+                while time.monotonic() < deadline:
+                    chunk = sock.recv(4096)
+                    if not chunk:
+                        closed = True
+                        break
+                assert closed
+            finally:
+                sock.close()
+            assert ws.stats.protocol_errors == 1
+
+    def test_close_is_idempotent_and_joins(self):
+        target = _StallTarget()
+        ws = wire.WireServer(target, port=0)
+        with wire.WireClient(port=ws.port) as c:
+            c.ping()
+            ws.close()
+            ws.close()
+        assert not ws._accept_thread.is_alive()

@@ -67,6 +67,11 @@ DEFAULT_METRICS: tuple = (
     ),
     ("extra_metrics.serving.cifar_conv.qps", "higher", 0.30),
     ("extra_metrics.serving.cifar_conv.p99_latency_ms", "lower", 0.50),
+    # ISSUE 12: the wire front-end's socket-path tail latency and the
+    # shape router's own routing cost — both lower-is-better so a slow
+    # route table or a chatty protocol regresses loudly across rounds.
+    ("extra_metrics.serving.wire_p99_ms", "lower", 0.50),
+    ("extra_metrics.serving.router_route_overhead_us", "lower", 1.00),
     ("extra_metrics.solve_at_scale.examples_per_sec", "higher", 0.30),
     ("extra_metrics.placement.max_search_overhead_frac", "lower", 1.00),
 )

@@ -22,10 +22,11 @@ appear in its schedule's trace as a counted ``fault`` instant event with a
 matching ``kind`` attribute, and a typed-error outcome must be visible as a
 failed span carrying the error type — typed-error spans are never silent.
 A schedule whose trace misses either fails the run like any other
-violation.  The assertion covers ALL 17 fault families (the streaming,
-snapshot, decode-worker, serving, and placement families included) and the
-tier-1 suite runs every schedule traced (tests/test_chaos.py), so the
-invariant is continuously enforced, not just on demand.
+violation.  The assertion covers ALL 19 fault families (the streaming,
+snapshot, decode-worker, serving, wire-protocol, and placement families
+included) and the tier-1 suite runs every schedule traced
+(tests/test_chaos.py), so the invariant is continuously enforced, not just
+on demand.
 
 Exit status is nonzero if ANY schedule violates the invariant.  The first
 stdout line is the machine-readable JSON record (truncation-proof, same
@@ -65,8 +66,9 @@ def main(argv=None) -> int:
         "--serve",
         action="store_true",
         help="run only the serving fault schedules (slow_client / "
-        "malformed_request / serve_burst_oom families, the core.serve "
-        "online path)",
+        "malformed_request / serve_burst_oom / wire_disconnect / "
+        "slow_loris families — the core.serve, core.frontend, and "
+        "core.wire online paths)",
     )
     p.add_argument("--workload", default="mnist", choices=("mnist", "cifar"))
     p.add_argument(

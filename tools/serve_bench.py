@@ -1,0 +1,390 @@
+#!/usr/bin/env python
+"""Serving front-end bench CLI: the shape-routed endpoint under load,
+in-process or over real sockets (``--wire``) from separate client
+processes.
+
+Default (in-process) mode builds one deterministic toy engine per
+``--shapes`` entry, registers them with a
+:class:`~keystone_tpu.core.frontend.ShapeRouter`, and drives a
+mixed-shape request stream from concurrent in-process clients — reporting
+per-shape p50/p99/QPS, the router's stats (engines, routes, warm adds,
+retires), and the ``router_route_overhead_us`` histogram the regression
+observatory (tools/bench_diff.py) watches.
+
+``--wire`` additionally binds a :class:`~keystone_tpu.core.wire.WireServer`
+and spawns ``--clients`` SEPARATE CLIENT PROCESSES (tools/serve_client.py,
+pinned to CPU so they never race the server for an accelerator) driving
+real sockets, round-robin over the shapes.  Client records are merged with
+exact cross-client percentiles; the headline ``wire_p99_ms`` is the p99
+over every request of every client process.  ``--shift`` replays a
+request-shape-mix shift over the wire: a shape with no engine goes hot
+(RETRY_AFTER backpressure until the router warms an engine for it), then
+the retire sweep runs — the record proves the warm add and the retire.
+
+The first stdout line is the machine-readable JSON record (the bench.py
+convention); human-readable lines follow.  Exit 0 on success, 1 on any
+failed client or lost request.
+
+Usage:
+    python tools/serve_bench.py                        # in-process
+    python tools/serve_bench.py --wire --clients 4     # real sockets
+    python tools/serve_bench.py --wire --shift         # + mix-shift replay
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np  # noqa: E402
+
+
+def parse_shapes(raw: str) -> list[tuple]:
+    from serve_client import parse_shape
+
+    return [parse_shape(tok) for tok in raw.split(",") if tok.strip()]
+
+
+def toy_engine(shape: tuple, dtype=np.dtype(np.float32)):
+    """Deterministic per-shape engine (the chaos harness's
+    fusion-invariant mul+max idiom: eager == jit == every bucket, so wire
+    answers are byte-verifiable)."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.core import frontend, serve as kserve
+    from keystone_tpu.core.pipeline import FunctionTransformer
+
+    rng = np.random.default_rng(20260803 + int(np.prod(shape, dtype=np.int64)))
+    w = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    pipe = FunctionTransformer(lambda x: jnp.maximum(x * w, b), name="bench")
+    cfg = kserve.ServeConfig.from_env(buckets=(1, 4, 16), max_wait_ms=2.0)
+    return kserve.ServingEngine(
+        pipe,
+        np.zeros(shape, np.float32),
+        config=cfg,
+        label=frontend.shape_label("serve_bench", shape),
+    )
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    return float(
+        sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+    )
+
+
+def _shape_key(shape) -> str:
+    return "x".join(str(d) for d in shape) or "scalar"
+
+
+def run_inproc(router, shapes, clients, requests_per_client, timeout) -> dict:
+    """Concurrent in-process clients, round-robin over shapes, pipelined
+    depth 8 — per-shape latency percentiles from the futures' own
+    submit-to-answer clocks."""
+    lat_by_shape: dict[str, list] = {_shape_key(s): [] for s in shapes}
+    errors: list = []
+    lock = threading.Lock()
+
+    def client(cid: int):
+        shape = shapes[cid % len(shapes)]
+        rng = np.random.default_rng(1000 + cid)
+        reqs = rng.standard_normal(
+            (requests_per_client, *shape)
+        ).astype(np.float32)
+        lats = []
+        try:
+            pending = []
+            for r in reqs:
+                pending.append(router.submit(r))
+                if len(pending) >= 8:
+                    fut = pending.pop(0)
+                    fut.result(timeout)
+                    lats.append(fut.latency_seconds() * 1e3)
+            for fut in pending:
+                fut.result(timeout)
+                lats.append(fut.latency_seconds() * 1e3)
+            with lock:
+                lat_by_shape[_shape_key(shape)].extend(lats)
+        except BaseException as e:  # noqa: BLE001 — surfaced in the record
+            errors.append(f"client {cid}: {type(e).__name__}: {e}")
+
+    threads = [
+        threading.Thread(target=client, args=(c,)) for c in range(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    wall = time.perf_counter() - t0
+    total = sum(len(v) for v in lat_by_shape.values())
+    return {
+        "clients": clients,
+        "requests": total,
+        "wall_seconds": round(wall, 4),
+        "qps": round(total / wall, 2) if wall > 0 else 0.0,
+        "per_shape": {
+            k: {
+                "requests": len(v),
+                "p50_ms": round(_percentile(sorted(v), 0.50), 3),
+                "p99_ms": round(_percentile(sorted(v), 0.99), 3),
+            }
+            for k, v in lat_by_shape.items()
+        },
+        "errors": errors,
+    }
+
+
+def run_wire(
+    ws, shapes, clients, requests_per_client, timeout
+) -> dict:
+    """Spawn ``clients`` separate serve_client.py processes against the
+    live socket server and merge their records (exact percentiles from
+    the pooled per-request latencies)."""
+    procs = []
+    for cid in range(clients):
+        shape = shapes[cid % len(shapes)]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"  # clients never touch the accelerator
+        cmd = [
+            sys.executable,
+            os.path.join(_ROOT, "tools", "serve_client.py"),
+            "--port", str(ws.port),
+            "--shape", _shape_key(shape),
+            "--requests", str(requests_per_client),
+            "--seed", str(cid),
+            "--timeout", str(timeout),
+        ]
+        procs.append(
+            (cid, shape, subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env, cwd=_ROOT,
+            ))
+        )
+    client_records = []
+    errors = []
+    for cid, shape, proc in procs:
+        try:
+            out, err = proc.communicate(timeout=timeout + 120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            errors.append(f"client {cid}: timed out")
+            continue
+        if proc.returncode != 0:
+            errors.append(
+                f"client {cid}: exit {proc.returncode}: {err[-400:]}"
+            )
+            continue
+        try:
+            rec = json.loads(out.splitlines()[0])
+        except (json.JSONDecodeError, IndexError) as e:
+            errors.append(f"client {cid}: unparsable record: {e}")
+            continue
+        rec["client"] = cid
+        client_records.append(rec)
+    lat_by_shape: dict[str, list] = {}
+    reqs_by_shape: dict[str, int] = {}
+    for rec in client_records:
+        key = _shape_key(rec.get("shape", []))
+        lat_by_shape.setdefault(key, []).extend(
+            rec.get("latencies_ms", [])
+        )
+        reqs_by_shape[key] = reqs_by_shape.get(key, 0) + rec["requests"]
+    all_lat = sorted(v for vals in lat_by_shape.values() for v in vals)
+    per_shape = {
+        k: {
+            "requests": reqs_by_shape[k],
+            "p50_ms": round(_percentile(sorted(v), 0.50), 3),
+            "p99_ms": round(_percentile(sorted(v), 0.99), 3),
+        }
+        for k, v in lat_by_shape.items()
+    }
+    for rec in client_records:
+        rec.pop("latencies_ms", None)  # merged above; keep records small
+    return {
+        "clients": clients,
+        "client_processes": [
+            {"client": r["client"], "pid_record": r} for r in client_records
+        ],
+        # answered count from the client records themselves — latencies_ms
+        # is a (possibly sampled) distribution, not the request ledger.
+        "requests": sum(r["requests"] for r in client_records),
+        "per_shape": per_shape,
+        "wire_p50_ms": round(_percentile(all_lat, 0.50), 3),
+        "wire_p99_ms": round(_percentile(all_lat, 0.99), 3),
+        "retry_after_total": sum(
+            r.get("retry_after", 0) for r in client_records
+        ),
+        "errors": errors,
+    }
+
+
+def run_shift(router, ws, shapes, timeout) -> dict:
+    """The mix-shift replay over the wire: a NEW shape goes hot (the
+    client absorbs RETRY_AFTER pushback until the router warms an engine),
+    then the retire sweep reclaims the now-idle original engines —
+    warm add + retire proven over a live socket with zero lost requests."""
+    new_shape = (int(np.prod(shapes[0], dtype=np.int64)) + 3,)
+    warm_before = router.stats.warm_adds
+    retire_before = router.stats.retires
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [
+        sys.executable,
+        os.path.join(_ROOT, "tools", "serve_client.py"),
+        "--port", str(ws.port),
+        "--shape", _shape_key(new_shape),
+        "--requests", "24",
+        "--seed", "777",
+        "--timeout", str(timeout),
+    ]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout + 120,
+        env=env, cwd=_ROOT,
+    )
+    out: dict = {"new_shape": list(new_shape)}
+    if proc.returncode != 0:
+        out["error"] = f"shift client failed: {proc.stderr[-400:]}"
+        return out
+    rec = json.loads(proc.stdout.splitlines()[0])
+    rec.pop("latencies_ms", None)
+    out["client"] = rec
+    out["warm_adds"] = router.stats.warm_adds - warm_before
+    # The shifted-away shapes stopped earning traffic — run the retire
+    # sweep with a bounded idle threshold so the replay is deterministic
+    # (the new engine routed most recently and survives the sweep's
+    # idlest-first order + min_engines floor).
+    saved = router.config.retire_after_s
+    try:
+        router.config.retire_after_s = 1.0
+        time.sleep(1.1)
+        router.adapt()
+    finally:
+        router.config.retire_after_s = saved
+    out["retires"] = router.stats.retires - retire_before
+    out["new_shape_live"] = tuple(new_shape) in router.engines()
+    # drive() answers every request or dies nonzero (caught above), so a
+    # successful client record IS the zero-loss proof.
+    out["lost_requests"] = 24 - rec["requests"]
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("serve_bench")
+    p.add_argument(
+        "--shapes", default="16,64",
+        help="comma-separated request shapes (16 or 32x32x3)",
+    )
+    p.add_argument("--clients", type=int, default=None,
+                   help="default: 4 in-process, 2 wire processes")
+    p.add_argument("--requests", type=int, default=64,
+                   help="requests per client")
+    p.add_argument("--wire", action="store_true",
+                   help="bind a socket server and drive it from separate "
+                   "client processes")
+    p.add_argument("--port", type=int, default=0,
+                   help="wire port (0 = ephemeral)")
+    p.add_argument("--shift", action="store_true",
+                   help="with --wire: replay a shape-mix shift (warm add "
+                   "+ retire over a live socket)")
+    p.add_argument("--timeout", type=float, default=120.0)
+    a = p.parse_args(argv)
+
+    from keystone_tpu.core import frontend, trace, wire
+
+    shapes = parse_shapes(a.shapes)
+    cfg = frontend.RouterConfig.from_env(warm_threshold=2, min_engines=1)
+    record: dict = {
+        "metric": "serve_bench",
+        "wire": bool(a.wire),
+        "shapes": [list(s) for s in shapes],
+        "requests_per_client": a.requests,
+    }
+    t0 = time.perf_counter()
+    router = frontend.ShapeRouter(
+        toy_engine, label="serve_bench", config=cfg
+    )
+    ok = True
+    try:
+        for shape in shapes:
+            router.add_engine(toy_engine(shape))
+        record["engine_build_seconds"] = round(time.perf_counter() - t0, 3)
+        if a.wire:
+            clients = a.clients or 2
+            with wire.WireServer(
+                router, port=a.port, label="serve_bench"
+            ) as ws:
+                bench = run_wire(
+                    ws, shapes, clients, a.requests, a.timeout
+                )
+                if a.shift:
+                    record["shift"] = run_shift(router, ws, shapes, a.timeout)
+                record["wire_server"] = ws.record()
+            record["bench"] = bench
+            record["wire_p99_ms"] = bench["wire_p99_ms"]
+            ok = not bench["errors"] and bench["requests"] == (
+                clients * a.requests
+            )
+            if a.shift:
+                sh = record["shift"]
+                ok = ok and "error" not in sh and sh["lost_requests"] == 0 \
+                    and sh["warm_adds"] >= 1 and sh["retires"] >= 1
+        else:
+            clients = a.clients or 4
+            bench = run_inproc(
+                router, shapes, clients, a.requests, a.timeout
+            )
+            record["bench"] = bench
+            ok = not bench["errors"] and bench["requests"] == (
+                clients * a.requests
+            )
+        snap = trace.metrics.snapshot()
+        overhead = snap["histograms"].get("router_route_overhead_us", {})
+        record["router_route_overhead_us"] = {
+            k: round(overhead[k], 3)
+            for k in ("mean", "p50", "p99")
+            if k in overhead
+        }
+        record["router"] = router.record()
+    finally:
+        router.close()
+    record["ok"] = bool(ok)
+    record["seconds"] = round(time.perf_counter() - t0, 3)
+    print(json.dumps(record), flush=True)
+    b = record.get("bench", {})
+    for key, row in sorted(b.get("per_shape", {}).items()):
+        print(
+            f"# shape {key}: {row['requests']} requests, p50 "
+            f"{row['p50_ms']}ms, p99 {row['p99_ms']}ms"
+        )
+    stats = record["router"]["stats"]
+    print(
+        f"# router: {len(record['router']['engines'])} engine(s), "
+        f"{stats['routes']} routed, {stats['warm_adds']} warm add(s), "
+        f"{stats['retires']} retire(s), {stats['rejected']} pushback(s)"
+    )
+    if a.wire:
+        print(
+            f"# wire: {b.get('requests')} requests from "
+            f"{b.get('clients')} client process(es), p99 "
+            f"{b.get('wire_p99_ms')}ms, "
+            f"{b.get('retry_after_total')} retry-after"
+        )
+    for err in b.get("errors", []):
+        print(f"# ERROR {err}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
