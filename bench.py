@@ -182,10 +182,25 @@ def timed_chain_auto(fn, arg, chain_len: int, max_len: int = 2048) -> float:
             chain_len *= 2
 
 
-def _make_jpeg_tar(rng, n_images: int, size: int, labeled: bool = False) -> str:
+def _make_jpeg_tar(
+    rng,
+    n_images: int,
+    size: int,
+    labeled: bool = False,
+    subsamplings: tuple | None = None,
+    qualities: tuple = (90,),
+    restart_every: int = 0,
+) -> str:
     """Temp tar of random ``size``-px JPEGs for the ingest benches (the
     caller unlinks it).  ``labeled=True`` prefixes members with a 0-9 class
-    directory — the name-borne-label layout the CIFAR stream path reads."""
+    directory — the name-borne-label layout the CIFAR stream path reads.
+
+    ``subsamplings`` / ``qualities`` cycle PER MEMBER (PIL subsampling
+    codes: 0 = 4:4:4, 1 = 4:2:2, 2 = 4:2:0; ``None`` keeps the encoder
+    default) and ``restart_every`` adds restart markers every N MCU rows
+    on every third member — so the tar exercises the corpus the DEVICE
+    decode path (ops.jpeg_device) actually claims, not one
+    encoder-default shape."""
     import io
     import tarfile
     import tempfile
@@ -198,7 +213,12 @@ def _make_jpeg_tar(rng, n_images: int, size: int, labeled: bool = False) -> str:
         for i in range(n_images):
             arr = rng.integers(0, 256, (size, size, 3), dtype=np.uint8)
             buf = io.BytesIO()
-            PILImage.fromarray(arr).save(buf, format="JPEG", quality=90)
+            kw = {"quality": qualities[i % len(qualities)]}
+            if subsamplings is not None:
+                kw["subsampling"] = subsamplings[i % len(subsamplings)]
+            if restart_every and i % 3 == 0:
+                kw["restart_marker_rows"] = restart_every
+            PILImage.fromarray(arr).save(buf, format="JPEG", **kw)
             data = buf.getvalue()
             name = f"{i % 10}/img_{i:05d}.jpg" if labeled else f"img_{i:05d}.jpg"
             info = tarfile.TarInfo(name)
@@ -1492,6 +1512,166 @@ def bench_optimizer(rng):
     return out
 
 
+def _decode_path_breakdown(
+    rng, batch: int = 16, n_images: int = 48, size: int = 96
+):
+    """The ISSUE 13 per-path decode ledger: ONE mixed corpus tar (4:4:4 /
+    4:2:2 / 4:2:0, qualities 85/90/95, restart markers — the subset the
+    device path claims) measured through three ingest paths:
+
+    * ``host_pool`` — threaded host decode, device featurize;
+    * ``device`` — entropy-only host pass, batched dequant+IDCT+upsample+
+      colorspace FUSED into the featurize (ops.jpeg_device);
+    * ``device_snapshot_warm`` — warm epoch off the device-format
+      snapshot tier (pure DMA: zero host decode/transform).
+
+    Each path records e2e, decode-only and featurize-only images/sec plus
+    ``overlap_efficiency`` = e2e / min(decode, featurize) (the PR 4
+    definition), and the device path records its golden parity vs the
+    host decoder.  Every path runs one untimed warmup pass first so
+    compile time never pollutes a rate."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.ingest import StreamConfig, stream_batches
+
+    n = n_images
+    tar_path = _make_jpeg_tar(
+        rng, n, size, subsamplings=(0, 1, 2), qualities=(85, 90, 95),
+        restart_every=2,
+    )
+    feat = jax.jit(
+        lambda x: jnp.stack(
+            [jnp.mean(x, axis=(1, 2, 3)), jnp.max(x, axis=(1, 2, 3))],
+            axis=1,
+        )
+    )
+    snap_root = tempfile.mkdtemp(prefix="bench_devsnap_")
+
+    def one_pass(transfer, featurize, collect=False, **cfg_kw):
+        cfg_kw.setdefault("snapshot_dir", "")  # ambient cache pinned off
+        cfg = StreamConfig.from_env(**cfg_kw)
+        chunks = []
+        t0 = time.perf_counter()
+        count = 0
+        with stream_batches(
+            tar_path, batch, transfer=transfer, config=cfg
+        ) as st:
+            for b in st:
+                if featurize:
+                    np.asarray(b.apply(feat))
+                if collect:
+                    chunks.append(b)
+                count += len(b)
+        secs = time.perf_counter() - t0
+        assert st.join(20.0), "ingest threads leaked"
+        assert count == n, (count, n)
+        return n / secs, st.stats, chunks
+
+    def feat_only_rate(chunks):
+        # warmup already happened in the pass that collected the chunks
+        t0 = time.perf_counter()
+        for b in chunks:
+            np.asarray(b.apply(feat))
+        return n / (time.perf_counter() - t0)
+
+    out = {}
+    try:
+        # -- host thread pool -------------------------------------------------
+        one_pass(True, True)  # warmup (jit compiles)
+        host_e2e, _s, _ = one_pass(True, True)
+        host_dec, _s, host_chunks = one_pass(False, False, collect=True)
+        host_feat = feat_only_rate(host_chunks)
+        out["host_pool"] = {
+            "images_per_sec": round(host_e2e, 2),
+            "decode_images_per_sec": round(host_dec, 2),
+            "featurize_images_per_sec": round(host_feat, 2),
+            "overlap_efficiency": round(
+                host_e2e / max(1e-9, min(host_dec, host_feat)), 3
+            ),
+        }
+
+        # -- device decode (entropy host pass + fused on-device pixels) -------
+        one_pass(True, True, decode_mode="device")  # warmup
+        dev_e2e, dev_stats, _ = one_pass(True, True, decode_mode="device")
+        dev_dec, _s, dev_chunks = one_pass(
+            False, False, collect=True, decode_mode="device"
+        )
+        dev_feat = feat_only_rate(dev_chunks)
+        # golden parity: device vs host pixels matched BY MEMBER NAME —
+        # the two paths bucket differently (device buckets fold the
+        # sampling geometry in), so chunk i holds different images.
+        def pixels_by_name(chunks, limit=9):
+            got = {}
+            for b in chunks:
+                px = np.asarray(b.dev())
+                for j, nm in enumerate(b.names):
+                    if len(got) < limit:
+                        got[nm] = px[j]
+                if len(got) >= limit:
+                    break
+            return got
+
+        from keystone_tpu.ops.jpeg_device import GOLDEN_MAX_ABS
+
+        host_px = pixels_by_name(host_chunks)
+        dev_px = pixels_by_name(dev_chunks, limit=n)
+        common = sorted(set(host_px) & set(dev_px))
+        assert common, "no overlapping members between the two paths"
+        parity = max(
+            float(np.max(np.abs(dev_px[nm] - host_px[nm])))
+            for nm in common
+        )
+        out["device"] = {
+            "images_per_sec": round(dev_e2e, 2),
+            "decode_images_per_sec": round(dev_dec, 2),  # entropy pass
+            "featurize_images_per_sec": round(dev_feat, 2),
+            "overlap_efficiency": round(
+                dev_e2e / max(1e-9, min(dev_dec, dev_feat)), 3
+            ),
+            "entropy_decoded": dev_stats.entropy_decoded,
+            "fallbacks": dev_stats.device_fallbacks,
+            "coeff_bytes": dev_stats.coeff_bytes,
+            "golden_max_abs_vs_host": parity,
+            "within_golden_tolerance": bool(parity <= GOLDEN_MAX_ABS),
+        }
+
+        # -- warm device-format snapshot (pure DMA) ---------------------------
+        # cold pass (host decode + device-format tee), untimed
+        one_pass(
+            True, True, snapshot_dir=snap_root, snapshot_mode="device"
+        )
+        warm_e2e, warm_stats, _ = one_pass(
+            True, True, snapshot_dir=snap_root, snapshot_mode="device"
+        )
+        warm_dec, _s, _ = one_pass(
+            False, False, snapshot_dir=snap_root, snapshot_mode="device"
+        )
+        out["device_snapshot_warm"] = {
+            "images_per_sec": round(warm_e2e, 2),
+            "decode_images_per_sec": round(warm_dec, 2),  # shard DMA
+            "featurize_images_per_sec": round(host_feat, 2),
+            "overlap_efficiency": round(
+                warm_e2e / max(1e-9, min(warm_dec, host_feat)), 3
+            ),
+            "dma_bytes": warm_stats.snapshot_dma_bytes,
+            # the acceptance bar: a warm device epoch does ZERO host-side
+            # decode/transform — recorded, not assumed
+            "zero_host_decode": bool(
+                warm_stats.entropy_decoded == 0
+                and warm_stats.device_fallbacks == 0
+                and warm_stats.snapshot_chunks_read > 0
+            ),
+        }
+    finally:
+        os.unlink(tar_path)
+        shutil.rmtree(snap_root, ignore_errors=True)
+    return out
+
+
 def bench_decode(rng):
     """Host ingest: JPEG-tar decode throughput — serial, thread-pool,
     PROCESS-pool at 1/2/4/8 workers, and snapshot cold-write vs warm-read
@@ -1695,6 +1875,10 @@ def bench_decode(rng):
         out["native_vs_pil_speedup"] = round(serial / pil_serial, 2)
     else:
         out["native_vs_pil_speedup"] = None  # native decoder disabled/absent
+    # ISSUE 13: per-path breakdown over the mixed device-decode corpus —
+    # host pool vs device decode vs warm device-snapshot DMA, with
+    # overlap efficiency and golden parity recorded per path.
+    out["by_path"] = _decode_path_breakdown(rng)
     return out
 
 
@@ -2130,6 +2314,19 @@ def main():
                 f"{sn['cold_write_images_per_sec']}/s -> warm "
                 f"{sn['warm_read_images_per_sec']}/s "
                 f"(x{sn['warm_speedup_vs_serial_decode']} vs serial decode)"
+            )
+        bp = jd.get("by_path")
+        if bp:
+            dev = bp["device"]
+            print(
+                "# jpeg_decode by_path e2e: host_pool "
+                f"{bp['host_pool']['images_per_sec']}/s, device "
+                f"{dev['images_per_sec']}/s (overlap "
+                f"{dev['overlap_efficiency']}, parity "
+                f"{dev['golden_max_abs_vs_host']}), warm device-snapshot "
+                f"{bp['device_snapshot_warm']['images_per_sec']}/s "
+                "(zero_host_decode="
+                f"{bp['device_snapshot_warm']['zero_host_decode']})"
             )
     e2x = ex["e2e"]
     if "error" in e2x:

@@ -62,6 +62,23 @@ images/sec):
   (:class:`SnapshotFallbackDivergence`) if the survivor sequences
   diverged rather than scramble ordinals.
 
+The THIRD decode-wall attack (ISSUE 13) moves the pixel math off the host
+entirely: ``decode_mode="device"`` (``KEYSTONE_DEVICE_DECODE=1``) has the
+producer threads run an ENTROPY-ONLY pass (ops.jpeg_device: markers +
+Huffman -> quantized DCT coefficients), the ring carries
+:class:`CoeffChunk` coefficient chunks bucketed by JPEG geometry, the
+transfer stage double-buffers H2D of coefficients (~1/4 of pixel bytes),
+and ``StreamBatch.apply`` fuses dequant/IDCT/upsample/colorspace INTO the
+featurize program — pixels are born on device.  JPEGs outside the
+baseline subset fall back to the host decode path counted per reason
+(``device_decode_fallback_<reason>``); damaged scans are typed counted
+skips (``jpeg_corrupt_entropy``, chaos family of the same name).  The
+decoded-pixel snapshot cache does not compose with device decode
+(different IDCT rounding — disabled counted); the DEVICE-FORMAT snapshot
+tier (``snapshot_mode="device"``) stores dtype-final padded shards on the
+(host-decoded) cold pass so warm epochs are pure DMA with zero host
+transform.
+
 Every sizing knob lives in a mutable :class:`StreamConfig` (env-seeded:
 the ``KEYSTONE_DECODE_THREADS`` / ``KEYSTONE_DECODE_AHEAD`` /
 ``KEYSTONE_RING_CAPACITY`` values are INITIAL settings, no longer frozen
@@ -174,6 +191,12 @@ def _env_int(name: str, default: int, minimum: int) -> int:
 #: spawned worker processes returning pixels via shared memory.
 DECODE_BACKENDS = ("thread", "process")
 
+#: Where pixels are born: ``host`` (full decode on the host, the classic
+#: path) or ``device`` (host does the entropy pass only, the ring carries
+#: quantized DCT coefficient chunks, and dequant/IDCT/upsample/colorspace
+#: run batched on the accelerator — ops.jpeg_device).
+DECODE_MODES = ("host", "device")
+
 
 def decode_backend_env() -> str:
     """``KEYSTONE_DECODE_BACKEND``: ``thread`` (default) or ``process``."""
@@ -183,6 +206,19 @@ def decode_backend_env() -> str:
             f"KEYSTONE_DECODE_BACKEND={raw!r} must be one of {DECODE_BACKENDS}"
         )
     return raw
+
+
+def decode_mode_env() -> str:
+    """``KEYSTONE_DEVICE_DECODE``: ``1`` (or ``device``) turns on
+    device-resident decode; default ``host``."""
+    raw = os.environ.get("KEYSTONE_DEVICE_DECODE", "").strip().lower()
+    if raw in ("", "0", "off", "false", "host"):
+        return "host"
+    if raw in ("1", "on", "true", "device", "yes"):
+        return "device"
+    raise ValueError(
+        f"KEYSTONE_DEVICE_DECODE={raw!r} must be 0/1 (or host/device)"
+    )
 
 
 @dataclasses.dataclass
@@ -222,6 +258,15 @@ class StreamConfig:
     decode_backend: str = "thread"
     #: Process-backend worker count; 0 -> resolved to decode_threads.
     decode_procs: int = 0
+    #: ``host`` = full pixel decode on the host (thread/process backend);
+    #: ``device`` = entropy-only host pass, coefficient chunks in the
+    #: ring, batched dequant+IDCT+upsample+colorspace on the accelerator
+    #: (ops.jpeg_device).  JPEGs outside the device path's baseline
+    #: subset fall back to host decode COUNTED per reason
+    #: (``device_decode_fallback_<reason>``); the entropy pass runs on
+    #: the thread pool regardless of ``decode_backend`` (it is the light
+    #: pass — the heavy math moved on-device).
+    decode_mode: str = "host"
     #: Snapshot cache root (None = off): first pass over the tar writes
     #: decoded chunks here, later passes stream them at IO speed
     #: (core.snapshot).  ``snapshot_mode="featurized"`` is handled ABOVE
@@ -256,6 +301,11 @@ class StreamConfig:
             )
         if self.decode_procs == 0:
             self.decode_procs = self.decode_threads
+        if self.decode_mode not in DECODE_MODES:
+            raise ValueError(
+                f"decode_mode={self.decode_mode!r} must be one of "
+                f"{DECODE_MODES}"
+            )
         if self.snapshot_mode not in ksnap.MODES:
             raise ValueError(
                 f"snapshot_mode={self.snapshot_mode!r} must be one of "
@@ -287,6 +337,7 @@ class StreamConfig:
             "autotune": _env_flag("KEYSTONE_AUTOTUNE"),
             "autotune_interval": _env_int("KEYSTONE_AUTOTUNE_INTERVAL", 4, 1),
             "decode_backend": decode_backend_env(),
+            "decode_mode": decode_mode_env(),
             "decode_procs": _env_int("KEYSTONE_DECODE_PROCS", 0, 0),
             "snapshot_dir": ksnap.snapshot_dir_env(),
             "snapshot_mode": ksnap.snapshot_mode_env(),
@@ -304,6 +355,52 @@ class StreamConfig:
 
 class _Cancelled(Exception):
     """Internal: the consumer stopped the stream — unwind the producer."""
+
+
+class _FallbackPixels:
+    """Device-decode task result: the JPEG is outside the device path's
+    baseline subset (``reason``) and was decoded on the host instead —
+    the producer counts the fallback per reason."""
+
+    __slots__ = ("reason", "img")
+
+    def __init__(self, reason: str, img):
+        self.reason = reason
+        self.img = img
+
+
+class _CorruptEntropy:
+    """Device-decode task result: the entropy-coded scan is damaged — a
+    typed, counted skip (``jpeg_corrupt_entropy``), never silent wrong
+    pixels."""
+
+    __slots__ = ("detail",)
+
+    def __init__(self, detail: str):
+        self.detail = detail
+
+
+def _entropy_decode_task(data: bytes):
+    """One member's DEVICE-mode decode task (thread pool): entropy-only
+    decode into a ``CoeffImage``; JPEGs the device path cannot claim fall
+    back to the full host decode TYPED (``_FallbackPixels``), damaged
+    scans come back as ``_CorruptEntropy``.  The device path reproduces
+    ``decode_image``'s reject rules (min dimension) so host and device
+    streams keep identical survivor sets."""
+    from ..ops import jpeg_device as jdev
+
+    try:
+        ci = jdev.entropy_decode(data)
+    except jdev.JpegEntropyCorrupt as e:
+        return _CorruptEntropy(str(e))
+    except jdev.JpegDecodeUnsupported as e:
+        return _FallbackPixels(e.reason, image_loaders.decode_image(data))
+    if (
+        ci.geom.height < image_loaders.MIN_DIM
+        or ci.geom.width < image_loaders.MIN_DIM
+    ):
+        return None  # the decode_image reject floor, same counted skip
+    return ci
 
 
 class SnapshotFallbackDivergence(RuntimeError):
@@ -649,29 +746,83 @@ class _ProcessDecodePool:
 
 
 @dataclasses.dataclass
+class CoeffChunk:
+    """Device-decode payload of one chunk: quantized DCT coefficients for
+    a batch of same-geometry JPEGs (what the ring carries instead of
+    pixels under ``decode_mode="device"``)."""
+
+    geom: object  #: ops.jpeg_device.JpegGeometry (hashable, shape-static)
+    coeffs: tuple  #: per-component [b, by, bx, 8, 8] int16 host arrays
+    qt: np.ndarray  #: [b, ncomp, 8, 8] f32 per-image dequant tables
+    #: (coeffs_on_device, qt_on_device) once the transfer stage ran —
+    #: the double-buffered H2D moves COEFFICIENTS, not pixels
+    device: tuple | None = None
+
+    def arrays(self) -> tuple:
+        return self.device if self.device is not None else (
+            self.coeffs, self.qt
+        )
+
+    def nbytes(self) -> int:
+        return sum(int(c.nbytes) for c in self.coeffs) + int(self.qt.nbytes)
+
+
+@dataclasses.dataclass
 class StreamBatch:
-    """One shape-bucketed, batch-assembled chunk of decoded images."""
+    """One shape-bucketed, batch-assembled chunk of decoded images.
+
+    Under ``decode_mode="device"`` a chunk may carry COEFFICIENTS instead
+    of pixels (``coeff`` set, ``host`` None): ``dev()`` then runs the
+    batched device decode, and :meth:`apply` fuses decode+featurize into
+    one jitted dispatch (ops.jpeg_device.fused_apply)."""
 
     index: int  #: chunk ordinal (FIFO yield order)
     indices: np.ndarray  #: [b] global image ordinals in decode-survival order
     names: list  #: [b] tar member names
-    host: np.ndarray  #: [b, H, W, C] f32 host batch
+    host: np.ndarray | None  #: [b, H, W, C] f32 host batch (None for coeff)
     device: object | None = None  #: jax.Array once the transfer stage ran
+    coeff: CoeffChunk | None = None  #: device-decode payload (host is None)
 
     @property
     def shape(self) -> tuple:
         """The bucket key: per-image (H, W)."""
+        if self.coeff is not None:
+            return (self.coeff.geom.height, self.coeff.geom.width)
         return tuple(self.host.shape[1:3])
 
     def __len__(self) -> int:
         return len(self.names)
 
     def dev(self):
-        """The device-resident batch (transferring on demand when the
-        stream ran with ``transfer=False``)."""
+        """The device-resident PIXEL batch (transferring — and for
+        coefficient chunks, device-decoding — on demand when the stream
+        ran with ``transfer=False``)."""
         if self.device is None:
-            self.device = _device_put(self.host)
+            self.device = (
+                _decode_coeffs(self.coeff)
+                if self.coeff is not None
+                else _device_put(self.host)
+            )
         return self.device
+
+    def apply(self, transform):
+        """``transform(pixels)`` for this chunk — FUSED with the device
+        decode into one jitted program for coefficient chunks (pixels are
+        never materialized between two dispatches), a plain call on the
+        device pixel batch otherwise."""
+        if self.coeff is None:
+            return transform(self.dev())
+        from ..ops import jpeg_device as jdev
+
+        coeffs, qt = self.coeff.arrays()
+        return jdev.fused_apply(transform, self.coeff.geom, coeffs, qt)
+
+
+def _decode_coeffs(chunk: CoeffChunk):
+    from ..ops import jpeg_device as jdev
+
+    coeffs, qt = chunk.arrays()
+    return jdev.decode_batch(chunk.geom, coeffs, qt)
 
 
 @dataclasses.dataclass
@@ -689,6 +840,11 @@ class StreamStats:
     snapshot_chunks_read: int = 0  #: chunks served from the snapshot cache
     snapshot_chunks_written: int = 0  #: chunks teed into a snapshot writer
     worker_respawns: int = 0  #: process-backend decode workers respawned
+    entropy_decoded: int = 0  #: images entropy-decoded (device decode mode)
+    entropy_corrupt: int = 0  #: typed+counted corrupt-scan skips
+    device_fallbacks: int = 0  #: JPEGs routed to host decode (counted per reason)
+    coeff_bytes: int = 0  #: coefficient payload bytes carried by the ring
+    snapshot_dma_bytes: int = 0  #: device-format shard bytes served straight to H2D
 
     def record(self) -> dict:
         return dataclasses.asdict(self)
@@ -834,6 +990,9 @@ class IngestStream:
         self._workers: list[threading.Thread] = []
         self._pool: ThreadPoolExecutor | None = None
         self._proc_pool: _ProcessDecodePool | None = None
+        #: resolved per produce pass (_produce_live): device decode is
+        #: forced OFF while a snapshot writer needs host pixels
+        self._device_decode = config.decode_mode == "device"
         self._writer = None  #: core.snapshot.SnapshotWriter while teeing
         self._skip_chunks = 0
         #: (names, indices) per chunk already served from a snapshot when a
@@ -923,6 +1082,21 @@ class IngestStream:
         the consumer lane, so decode/featurize overlap is a picture, not an
         inference.  The module attribute is resolved at call time (the
         chaos harness patches ``image_loaders.decode_image``)."""
+        if self._device_decode:
+            # Entropy-only pass: always the thread pool (the pass is the
+            # LIGHT half of the decode; the heavy math runs on-device) —
+            # a process backend setting governs the host-pixel path only.
+            pool = self._ensure_thread_pool()
+            if not trace.enabled():
+                return pool.submit(_entropy_decode_task, data)
+
+            def traced_entropy(data=data, name=name):
+                with trace.span(
+                    "ingest.entropy_decode", cat="ingest", member=name
+                ):
+                    return _entropy_decode_task(data)
+
+            return pool.submit(traced_entropy)
         if self.config.decode_backend == "process":
             return self._ensure_proc_pool().submit(name, data)
         pool = self._ensure_thread_pool()
@@ -955,11 +1129,30 @@ class IngestStream:
                 self._proc_pool.shutdown(clean)
 
     def _snapshot_plan(self):
-        """``(root, key)`` when the decoded-chunk snapshot cache applies to
-        this stream (``snapshot_mode="featurized"`` is the workload
-        helpers' business — the ring only ever carries decoded chunks)."""
+        """``(root, key, mode)`` when an ingest-level snapshot tier applies
+        to this stream — ``decoded`` (f32 pixel chunks, exactly what the
+        ring carried) or ``device`` (pre-laid-out device-format shards:
+        padded/bucketed, dtype-final, read back as pure DMA).
+        ``snapshot_mode="featurized"`` is the workload helpers' business —
+        the ring never carries feature rows.
+
+        ``decode_mode="device"`` + a DECODED snapshot is a contradiction
+        (device streams decode pixels on the accelerator, host-decoded
+        cached pixels differ within IDCT rounding — serving them would
+        silently change the stream's bits): the cache is disabled COUNTED
+        rather than silently served."""
         cfg = self.config
-        if not cfg.snapshot_dir or cfg.snapshot_mode != "decoded":
+        if not cfg.snapshot_dir or cfg.snapshot_mode not in (
+            "decoded", "device",
+        ):
+            return None
+        if cfg.decode_mode == "device" and cfg.snapshot_mode == "decoded":
+            counters.record(
+                "snapshot_mode_unsupported",
+                f"{self._path}: decoded-pixel snapshots do not compose "
+                "with device decode (different IDCT rounding) — use "
+                "snapshot_mode='device' for a DMA-format cache",
+            )
             return None
         if self._keep is not None and cfg.snapshot_extra is None:
             _logger.warning(
@@ -972,10 +1165,10 @@ class IngestStream:
         key = ksnap.snapshot_key(
             self._path,
             batch_size=self._batch_size,
-            mode="decoded",
+            mode=cfg.snapshot_mode,
             extra=cfg.snapshot_extra,
         )
-        return cfg.snapshot_dir, key
+        return cfg.snapshot_dir, key, cfg.snapshot_mode
 
     def _run_producer(self) -> bool:
         """Produce chunks — from the snapshot cache when a valid one
@@ -985,8 +1178,10 @@ class IngestStream:
         plan = self._snapshot_plan()
         skip = 0
         if plan is not None:
-            root, key = plan
-            snap, reason = ksnap.lookup(root, key, tar_path=self._path)
+            root, key, snap_mode = plan
+            snap, reason = ksnap.lookup(
+                root, key, tar_path=self._path, mode=snap_mode
+            )
             if reason == "stale":
                 counters.record(
                     "snapshot_stale",
@@ -1018,7 +1213,7 @@ class IngestStream:
                 self._writer = ksnap.SnapshotWriter(
                     root,
                     key,
-                    mode="decoded",
+                    mode=snap_mode,
                     meta={
                         "tar": ksnap.tar_identity(self._path),
                         "path": self._path,
@@ -1068,11 +1263,25 @@ class IngestStream:
                 for _entry, arrays in snap.iter_chunks():
                     if self._ring.stopped:
                         raise _Cancelled()
+                    payload = arrays["payload"]
+                    if snap.mode == "device":
+                        # Pre-laid-out shard: dtype-final f32, batch dim
+                        # padded to a sharding quantum.  The slice to
+                        # the valid rows is a zero-copy view — the shard
+                        # bytes flow straight into the consumer's
+                        # device_put with NO host transform (the warm
+                        # "pure DMA" epoch the tier exists for).
+                        self.stats.snapshot_dma_bytes += int(
+                            payload.nbytes
+                        )
+                        valid = int(arrays.get("valid", len(payload)))
+                        if valid < len(payload):
+                            payload = payload[:valid]
                     chunk = StreamBatch(
                         index=self._chunk_counter,
                         indices=np.asarray(arrays["indices"], np.int64),
                         names=[str(n) for n in arrays["names"].tolist()],
-                        host=arrays["payload"],
+                        host=payload,
                     )
                     self._chunk_counter += 1
                     with trace.span(
@@ -1108,16 +1317,80 @@ class IngestStream:
         from ..loaders.native_decode import available as _native_available
 
         _native_available()
+        # Frozen per pass: a snapshot tee needs host pixels (the writer
+        # materializes what the ring carried), and a corrupt-shard
+        # FALLBACK re-decode (skip_chunks > 0) must reproduce the pixel
+        # chunks the consumer already received — _emit's prefix
+        # suppression and divergence guard only exist on the pixel path,
+        # so the fallback pins host decode even when the rewrite writer
+        # failed to open.  Mid-stream decode_mode mutation would mix
+        # chunk kinds inconsistently, so the mode is not a live retune
+        # surface.
+        self._device_decode = (
+            self.config.decode_mode == "device"
+            and self._writer is None
+            and skip_chunks == 0
+        )
         # shape -> (ordinals, names, images); insertion-ordered so the
         # end-of-stream flush of partial buckets is deterministic.
         buckets: dict = {}
+        # geometry -> (ordinals, names, CoeffImages) for device decode
+        coeff_buckets: dict = {}
         window: collections.deque = collections.deque()
         ordinal = 0
 
-        def drain_one():
+        def keep_image(name, img):
             nonlocal ordinal
+            self.stats.decoded += 1
+            key = img.shape[:2]
+            idx, names, imgs = buckets.setdefault(key, ([], [], []))
+            idx.append(ordinal)
+            names.append(name)
+            imgs.append(img)
+            ordinal += 1
+            if len(imgs) >= self._batch_size:
+                self._emit(buckets.pop(key))
+
+        def keep_coeff(name, ci):
+            nonlocal ordinal
+            self.stats.decoded += 1
+            self.stats.entropy_decoded += 1
+            self.stats.coeff_bytes += ci.geom.coeff_bytes()
+            idx, names, imgs = coeff_buckets.setdefault(
+                ci.geom, ([], [], [])
+            )
+            idx.append(ordinal)
+            names.append(name)
+            imgs.append(ci)
+            ordinal += 1
+            if len(imgs) >= self._batch_size:
+                self._emit_coeff(ci.geom, coeff_buckets.pop(ci.geom))
+
+        def drain_one():
             name, fut = window.popleft()
             img = self._await_decode(fut)
+            if isinstance(img, _CorruptEntropy):
+                # Damaged entropy-coded scan under device decode: a TYPED,
+                # COUNTED skip — the rest of the batch survives, and the
+                # member never becomes silent wrong pixels.
+                counters.record(
+                    "jpeg_corrupt_entropy", f"{name}: {img.detail}"
+                )
+                self.stats.skipped += 1
+                self.stats.entropy_corrupt += 1
+                return
+            if isinstance(img, _FallbackPixels):
+                # Outside the device path's baseline subset: decoded on
+                # the host instead, counted PER REASON so a tar full of
+                # (say) progressive JPEGs is visible as exactly that.
+                counters.record(
+                    "device_decode_fallback", f"{name}: {img.reason}"
+                )
+                counters.record(
+                    f"device_decode_fallback_{img.reason}", name
+                )
+                self.stats.device_fallbacks += 1
+                img = img.img
             if img is None:
                 # "corrupt_image" for an undecodable member; the process
                 # backend may instead report "decode_worker_lost" (a task
@@ -1129,15 +1402,12 @@ class IngestStream:
                 )
                 self.stats.skipped += 1
                 return
-            self.stats.decoded += 1
-            key = img.shape[:2]
-            idx, names, imgs = buckets.setdefault(key, ([], [], []))
-            idx.append(ordinal)
-            names.append(name)
-            imgs.append(img)
-            ordinal += 1
-            if len(imgs) >= self._batch_size:
-                self._emit(buckets.pop(key))
+            from ..ops.jpeg_device import CoeffImage
+
+            if isinstance(img, CoeffImage):
+                keep_coeff(name, img)
+            else:
+                keep_image(name, img)
 
         with trace.span(
             "ingest.produce", cat="ingest", path=self._path
@@ -1160,12 +1430,21 @@ class IngestStream:
                 while window:
                     drain_one()
                 # Flush the batch-size remainders (partial last batch
-                # per shape), oldest bucket first for a deterministic
-                # tail order.
-                for bucket in sorted(
-                    buckets.values(), key=lambda b: b[0][0]
+                # per shape/geometry), oldest bucket first for a
+                # deterministic tail order across BOTH bucket kinds.
+                tails = [
+                    (b[0][0], None, b) for b in buckets.values()
+                ] + [
+                    (b[0][0], geom, b)
+                    for geom, b in coeff_buckets.items()
+                ]
+                for _first, geom, bucket in sorted(
+                    tails, key=lambda t: t[0]
                 ):
-                    self._emit(bucket)
+                    if geom is None:
+                        self._emit(bucket)
+                    else:
+                        self._emit_coeff(geom, bucket)
             except _Cancelled:
                 # Consumer stopped the stream early — routine shutdown
                 # (a supported path), not a producer failure: the span
@@ -1199,8 +1478,13 @@ class IngestStream:
         self._chunk_counter += 1
         if self._writer is not None:
             try:
+                # pad_to only applies to device-format shards (the writer
+                # pads the batch dim so warm epochs stream fixed-shape,
+                # sharding-ready buffers); decoded shards store exactly
+                # the chunk.
                 self._writer.add_chunk(
-                    chunk.index, chunk.indices, chunk.names, chunk.host
+                    chunk.index, chunk.indices, chunk.names, chunk.host,
+                    pad_to=self._batch_size,
                 )
                 self.stats.snapshot_chunks_written += 1
             except (OSError, ksnap.SnapshotError) as e:
@@ -1247,6 +1531,35 @@ class IngestStream:
             raise _Cancelled()
         self.stats.batches += 1
 
+    def _emit_coeff(self, geom, bucket):
+        """Assemble one same-geometry coefficient bucket into a
+        :class:`CoeffChunk`-carrying :class:`StreamBatch` (device decode
+        mode: the ring carries coefficients, never pixels).  Device-mode
+        passes never tee a snapshot (``_device_decode`` is forced off
+        while a writer is live), so no shard/suppression path exists
+        here."""
+        from ..ops.jpeg_device import stack_coeff_images
+
+        idx, names, imgs = bucket
+        coeffs, qt = stack_coeff_images(imgs)
+        chunk = StreamBatch(
+            index=self._chunk_counter,
+            indices=np.asarray(idx, np.int64),
+            names=names,
+            host=None,
+            coeff=CoeffChunk(geom=geom, coeffs=coeffs, qt=qt),
+        )
+        self._chunk_counter += 1
+        with trace.span(
+            "ingest.ring_put", cat="ingest",
+            index=chunk.index, images=len(chunk),
+            coeff_bytes=chunk.coeff.nbytes(),
+        ):
+            ok = self._ring.put(chunk)
+        if not ok:
+            raise _Cancelled()
+        self.stats.batches += 1
+
     # -- consumer side --------------------------------------------------------
 
     def _yield_consumed(self, item):
@@ -1278,6 +1591,15 @@ class IngestStream:
         m.gauge("ingest_decoded", self.stats.decoded)
         m.gauge("ingest_snapshot_chunks_read", self.stats.snapshot_chunks_read)
         m.gauge("ingest_worker_respawns", self.stats.worker_respawns)
+        # Device-decode surface: entropy-decode progress, coefficient
+        # bytes the ring carried, fallbacks to host decode, and
+        # device-format shard bytes served straight to H2D — the warm
+        # device-snapshot acceptance check reads these (all zero on a
+        # pure-DMA epoch except the dma gauge).
+        m.gauge("ingest_entropy_decoded", self.stats.entropy_decoded)
+        m.gauge("ingest_coeff_bytes", self.stats.coeff_bytes)
+        m.gauge("ingest_device_fallbacks", self.stats.device_fallbacks)
+        m.gauge("ingest_snapshot_dma_bytes", self.stats.snapshot_dma_bytes)
 
     def _drain(self):
         pending: collections.deque = collections.deque()
@@ -1290,8 +1612,18 @@ class IngestStream:
                 if self._transfer:
                     # Async dispatch: the H2D for this chunk starts now and
                     # overlaps the consumer's work on the PREVIOUS chunk
-                    # still being featurized.
-                    item.device = _device_put(item.host)
+                    # still being featurized.  Coefficient chunks transfer
+                    # their (much lighter) coefficient arrays — the pixel
+                    # batch is only ever born on device.
+                    if item.coeff is not None:
+                        item.coeff.device = (
+                            tuple(
+                                _device_put(c) for c in item.coeff.coeffs
+                            ),
+                            _device_put(item.coeff.qt),
+                        )
+                    else:
+                        item.device = _device_put(item.host)
                 self._publish_metrics()
                 if self.tuner is not None:
                     # Chunk boundary: the closed-loop controller reads the

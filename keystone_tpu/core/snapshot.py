@@ -61,7 +61,15 @@ _logger = logging.getLogger("keystone_tpu.snapshot")
 FORMAT_NAME = "keystone-tpu-snapshot"
 FORMAT_VERSION = 1
 MANIFEST_NAME = "snapshot.json"
-MODES = ("decoded", "featurized")
+#: ``decoded`` — f32 pixel chunks exactly as the ring carried them;
+#: ``featurized`` — [b, D] feature rows keyed by the fitted featurizer's
+#: digest; ``device`` — DEVICE-FORMAT pixel shards: dtype-final f32,
+#: batch dim padded to an 8-row sharding quantum capped at the stream
+#: batch size, never compressed and never compacted — a warm epoch reads a
+#: shard and hands the bytes straight to ``device_put`` with zero host
+#: transform (the tf.data-snapshot idea taken to its device-native
+#: conclusion).
+MODES = ("decoded", "featurized", "device")
 
 #: env vars (documented in README's KEYSTONE_* table)
 SNAPSHOT_DIR_ENV = "KEYSTONE_SNAPSHOT_DIR"
@@ -244,7 +252,10 @@ class SnapshotWriter:
         self._root = root
         self._key = key
         self._mode = mode
-        self._compress = (
+        # device-format shards are NEVER compressed (warm reads must be
+        # straight IO into H2D, not an inflate pass) — forced here so the
+        # manifest's compress field tells the truth too
+        self._compress = mode != "device" and (
             snapshot_compress_env() if compress is None else bool(compress)
         )
         self._meta = dict(meta or {})
@@ -256,14 +267,43 @@ class SnapshotWriter:
         self._images = 0
         self._done = False
 
-    def add_chunk(self, index: int, indices, names, payload) -> None:
+    def add_chunk(
+        self, index: int, indices, names, payload, *, pad_to: int | None = None
+    ) -> None:
         """Write one stream chunk as a shard.  ``payload`` is the decoded
-        [b, H, W, C] host batch (mode=decoded) or the [b, D] feature rows
-        (mode=featurized)."""
+        [b, H, W, C] host batch (mode=decoded), the [b, D] feature rows
+        (mode=featurized), or the dtype-final pixel batch (mode=device —
+        ``pad_to`` pads the batch dim to the stream batch size with zero
+        rows and records the ``valid`` count, so every warm shard is a
+        fixed-shape, sharding-ready buffer)."""
         if self._done:
             raise SnapshotError("snapshot writer already committed/aborted")
         payload = np.asarray(payload)
         extra = {}
+        if self._mode == "device":
+            # dtype-final: the bytes on disk ARE the bytes device_put
+            # consumes on the warm epoch — no cast, no compaction.  The
+            # batch dim pads up to an 8-row sharding quantum (divisible
+            # across typical data-parallel axes), CAPPED at the stream
+            # batch size — padding a lone remainder chunk all the way to
+            # a large batch size would multiply its shard bytes for no
+            # layout benefit (the reader slices to ``valid`` anyway).
+            payload = np.ascontiguousarray(payload, np.float32)
+            valid = int(payload.shape[0])
+            target = valid
+            if pad_to is not None and pad_to > valid:
+                target = min(int(pad_to), -(-valid // 8) * 8)
+            if target > valid:
+                payload = np.concatenate(
+                    [
+                        payload,
+                        np.zeros(
+                            (target - valid,) + payload.shape[1:],
+                            payload.dtype,
+                        ),
+                    ]
+                )
+            extra["valid"] = np.asarray(valid, np.int64)
         if payload.dtype == np.float32 and self._mode == "decoded":
             # Decoded pixels are integral f32 straight off uint8 JPEG
             # samples — store them as uint8 (4x less shard IO, the whole
@@ -279,7 +319,9 @@ class SnapshotWriter:
         # Write-path-only choice: np.load reads both formats transparently,
         # so compressed and plain shards coexist (old snapshots stay
         # readable, and the shard sha256 below covers whichever bytes were
-        # written).
+        # written).  Device-format shards are NEVER compressed: a warm
+        # epoch's read must be memory-bandwidth IO straight into H2D, not
+        # an inflate pass (that would be a host transform).
         save = np.savez_compressed if self._compress else np.savez
         save(
             buf,
@@ -290,9 +332,11 @@ class SnapshotWriter:
         )
         data = buf.getvalue()
         fname = f"chunk_{len(self._chunks):05d}.npz"
+        # image count = the VALID rows (== indices), never pad rows
+        n_images = int(np.asarray(indices).shape[0])
         with trace.io_span(
             "snapshot.write_shard", len(data), cat="snapshot",
-            file=fname, images=int(payload.shape[0]),
+            file=fname, images=n_images,
         ):
             with open(os.path.join(self._tmp, fname), "wb") as fh:
                 fh.write(data)
@@ -302,13 +346,13 @@ class SnapshotWriter:
                 "file": fname,
                 "bytes": len(data),
                 "sha256": hashlib.sha256(data).hexdigest(),
-                "images": int(payload.shape[0]),
+                "images": n_images,
                 "shape": list(payload.shape),
                 "compressed": self._compress,
                 "payload_bytes": int(payload.nbytes),
             }
         )
-        self._images += int(payload.shape[0])
+        self._images += n_images
 
     def commit(self) -> str:
         """Write the manifest and rename the directory into place.

@@ -26,6 +26,7 @@ TPU-native deviations from the reference (semantics preserved):
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 import time
 from dataclasses import dataclass
@@ -41,7 +42,11 @@ from ..core.ingest import stream_batches
 from ..core.logging import Logging, configure_logging, stage_timer
 from ..core.memory import log_fit_report
 from ..core.pipeline import FunctionTransformer, Pipeline
-from ..core.resilience import assert_all_finite, numerics_guard_enabled
+from ..core.resilience import (
+    assert_all_finite,
+    counters,
+    numerics_guard_enabled,
+)
 from ..evaluation.multiclass import MulticlassClassifierEvaluator
 from ..loaders.cifar import LabeledImageBatch, cifar_loader
 from ..ops.conv_fused import FusedConvFeaturizer
@@ -121,6 +126,11 @@ class RandomCifarConfig:
     #: the fitted featurizer's digest — and repeat runs stream the shards
     #: at IO speed.  None defers to ``KEYSTONE_SNAPSHOT_DIR``.
     snapshot_dir: str | None = None
+    #: Device-resident decode for the streamed test tar (ops.jpeg_device):
+    #: the host does the entropy pass only, pixels are born on-device and
+    #: fused into the conv featurize.  False defers to
+    #: ``KEYSTONE_DEVICE_DECODE``.
+    device_decode: bool = False
     #: Whole-fitted-SERVABLE-pipeline checkpoint stem (core.checkpoint):
     #: load-or-fit of conv featurizer + scaler + model + classifier — the
     #: artifact the serving endpoint warm-loads.
@@ -273,6 +283,19 @@ def cifar_tar_stream_loader(
     stream-ordinal (tar member) order, so the result is BIT-IDENTICAL to
     the eager loader on a clean tar: same images array, same labels, same
     order (the tests pin it)."""
+    if config is not None and config.decode_mode == "device":
+        # This loader's CONTRACT is host-resident pixels bit-identical to
+        # the eager loader (the filter-learning subset lives in host RAM);
+        # device decode would hand back coefficient chunks with no host
+        # batch and tolerance-level pixels.  Pin host decode, counted —
+        # an env-seeded KEYSTONE_DEVICE_DECODE=1 must not crash the
+        # streamed TRAIN path (the streamed TEST path honors it).
+        counters.record(
+            "device_decode_unsupported",
+            f"{path}: cifar_tar_stream_loader needs host-resident pixels "
+            "— decode_mode='device' ignored for the train stream",
+        )
+        config = dataclasses.replace(config, decode_mode="host")
     parts: list = []
     name_pairs: list = []
     n = 0
@@ -299,17 +322,25 @@ def cifar_tar_stream_loader(
 def _pad_to_chunk(batch, chunk: int):
     """One streamed batch padded up to the compiled ``chunk`` rows (the
     jitted featurizer has exactly one shape) — THE single implementation
-    of the compiled-chunk contract for the streaming paths."""
-    pad = chunk - batch.host.shape[0]
-    if pad > 0:
-        return jnp.asarray(
-            np.pad(batch.host, ((0, pad), (0, 0), (0, 0), (0, 0)))
-        )
+    of the compiled-chunk contract for the streaming paths.  Coefficient
+    chunks (device decode, ``batch.host is None``) materialize their
+    pixels on-device and pad THERE — the batch never round-trips through
+    the host."""
+    rows = len(batch)
+    pad = chunk - rows
     if pad < 0:
         raise ValueError(
-            f"streamed batch of {batch.host.shape[0]} rows exceeds the "
+            f"streamed batch of {rows} rows exceeds the "
             f"compiled featurize chunk {chunk} — stream with "
             "batch_size == featurize_chunk"
+        )
+    if pad > 0:
+        if batch.host is None:
+            return jnp.pad(
+                batch.dev(), ((0, pad), (0, 0), (0, 0), (0, 0))
+            )
+        return jnp.asarray(
+            np.pad(batch.host, ((0, pad), (0, 0), (0, 0), (0, 0)))
         )
     return batch.dev()
 
@@ -468,6 +499,7 @@ def run(
                 autotune=conf.auto_tune,
                 decode_backend=conf.decode_backend,
                 snapshot_dir=conf.snapshot_dir,
+                device_decode=conf.device_decode,
                 # this path wraps the stream in stream_features_snapshot,
                 # so mode=featurized is honored rather than degraded
                 supports_featurized=True,
@@ -493,6 +525,12 @@ def run(
                     batch_size=chunk,
                     mode="featurized",
                     featurizer=ksnap.featurizer_digest(conv_pipe),
+                    # decode_mode changes the PIXELS the features were
+                    # computed from (device decode differs within IDCT
+                    # rounding) — fold it in so a host-decode run can
+                    # never silently replay device-decoded features or
+                    # vice versa.
+                    extra=f"decode_mode={stream_cfg.decode_mode}",
                 )
             test_feats, names, st = stream_features_snapshot(
                 lambda: stream_batches(
@@ -684,8 +722,19 @@ def main(argv=None):
         help="snapshot cache root for --streamTestTar (core.snapshot): "
         "first pass materializes decoded chunks (or conv FEATURES under "
         "KEYSTONE_SNAPSHOT_MODE=featurized, keyed by the fitted "
-        "featurizer's digest); repeat runs stream the shards at IO speed "
+        "featurizer's digest; or DEVICE-FORMAT shards under "
+        "KEYSTONE_SNAPSHOT_MODE=device — warm epochs are pure DMA); "
+        "repeat runs stream the shards at IO speed "
         "(KEYSTONE_SNAPSHOT_DIR equivalent)",
+    )
+    p.add_argument(
+        "--deviceDecode",
+        action="store_true",
+        help="device-resident JPEG decode for --streamTestTar "
+        "(ops.jpeg_device): the host runs the entropy pass only, pixels "
+        "are born on-device fused into the conv featurize; unsupported "
+        "JPEGs fall back to host decode counted per reason "
+        "(KEYSTONE_DEVICE_DECODE=1 equivalent)",
     )
     p.add_argument(
         "--mesh",
@@ -763,6 +812,7 @@ def main(argv=None):
         auto_tune=a.autoTune,
         decode_backend=a.decodeBackend,
         snapshot_dir=a.snapshotDir,
+        device_decode=a.deviceDecode,
         pipeline_file=a.pipelineFile,
         serve=a.serve,
         serve_bench=a.serveBench,

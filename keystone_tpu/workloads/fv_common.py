@@ -268,13 +268,15 @@ def plan_pca_materialization(
 def stream_config_from_flags(
     *, autotune: bool = False, decode_backend: str | None = None,
     snapshot_dir: str | None = None, snapshot_extra: str | None = None,
-    supports_featurized: bool = False,
+    supports_featurized: bool = False, device_decode: bool | None = None,
 ):
     """One ``StreamConfig`` builder for every streaming workload: env-seeded
     (``KEYSTONE_*``), with the workload's ``--autoTune`` / ``--decodeBackend``
-    / ``--snapshotDir`` flags overriding the env defaults.  ``snapshot_extra``
-    keys the stream's member-selection inputs (keep filters, label files)
-    into the snapshot content hash.
+    / ``--snapshotDir`` / ``--deviceDecode`` flags overriding the env
+    defaults.  ``snapshot_extra`` keys the stream's member-selection inputs
+    (keep filters, label files) into the snapshot content hash.
+    ``device_decode=True`` selects ``decode_mode="device"`` (pixels born
+    on-device, ops.jpeg_device; env ``KEYSTONE_DEVICE_DECODE``).
 
     ``supports_featurized``: set by callers that wrap the stream in
     :func:`stream_features_snapshot`.  Everywhere else a
@@ -289,6 +291,7 @@ def stream_config_from_flags(
         decode_backend=decode_backend,
         snapshot_dir=snapshot_dir,
         snapshot_extra=snapshot_extra,
+        decode_mode="device" if device_decode else None,
     )
     if (
         cfg.snapshot_dir
@@ -469,7 +472,10 @@ def stream_descriptor_buckets(stream, per_batch) -> tuple[dict, list]:
     name_pairs: list = []
     n = 0
     for batch in stream:
-        descs = per_batch(batch.dev())
+        # batch.apply fuses the device decode into the featurize program
+        # for coefficient chunks (decode_mode="device"); for pixel chunks
+        # it is exactly per_batch(batch.dev())
+        descs = batch.apply(per_batch)
         parts.setdefault(batch.shape, []).append((batch.indices, descs))
         name_pairs.extend(zip(batch.indices.tolist(), batch.names))
         n += len(batch)
@@ -506,7 +512,9 @@ def scatter_features_streaming(stream, transform, feature_dim: int) -> tuple[np.
     name_pairs: list = []
     n = 0
     for batch in stream:
-        feats = transform(batch.dev())
+        # fused decode+featurize for coefficient chunks (device decode),
+        # plain transform(batch.dev()) for pixel chunks
+        feats = batch.apply(transform)
         # sync on the consumed batch only; later batches decode/transfer on
         parts.append((batch.indices, np.asarray(feats, np.float32)))
         name_pairs.extend(zip(batch.indices.tolist(), batch.names))

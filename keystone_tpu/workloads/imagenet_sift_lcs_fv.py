@@ -75,6 +75,9 @@ class ImageNetStreamSource:
     #: snapshot cache root (--snapshotDir): decoded chunks keyed by tar +
     #: decode config + the synset filter's label-file identity
     snapshot_dir: str | None = None
+    #: device-resident decode (--deviceDecode): entropy pass on the host,
+    #: pixels born on-device fused into each descriptor branch
+    device_decode: bool = False
 
     def __post_init__(self):
         self._names: list | None = None
@@ -138,6 +141,7 @@ def _streaming_buckets(src: ImageNetStreamSource, per_batch) -> dict:
         decode_backend=src.decode_backend,
         snapshot_dir=src.snapshot_dir,
         snapshot_extra=extra,
+        device_decode=src.device_decode,
     )
     with stream_batches(
         src.data_path, src.batch_size, keep=keep, config=cfg
@@ -560,6 +564,15 @@ def main(argv=None):
         "(KEYSTONE_SNAPSHOT_DIR equivalent)",
     )
     p.add_argument(
+        "--deviceDecode",
+        action="store_true",
+        help="device-resident JPEG decode for --streamIngest "
+        "(ops.jpeg_device): host entropy pass only, pixels born on-device "
+        "fused into each descriptor branch; unsupported JPEGs fall back "
+        "to host decode counted per reason (KEYSTONE_DEVICE_DECODE=1 "
+        "equivalent)",
+    )
+    p.add_argument(
         "--mesh",
         default=None,
         help="device mesh, e.g. '8' (data) or '4x2' (data x model)",
@@ -615,6 +628,7 @@ def main(argv=None):
             conf.train_location, conf.label_path,
             batch_size=a.streamBatchSize, autotune=a.autoTune,
             decode_backend=a.decodeBackend, snapshot_dir=a.snapshotDir,
+            device_decode=a.deviceDecode,
         )
     else:
         train = imagenet_loader(conf.train_location, conf.label_path)
@@ -623,6 +637,7 @@ def main(argv=None):
             conf.test_location, conf.label_path,
             batch_size=a.streamBatchSize, autotune=a.autoTune,
             decode_backend=a.decodeBackend, snapshot_dir=a.snapshotDir,
+            device_decode=a.deviceDecode,
         )
     else:
         test = imagenet_loader(conf.test_location, conf.label_path)

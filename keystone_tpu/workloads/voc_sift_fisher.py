@@ -75,6 +75,9 @@ class VOCStreamSource:
     #: snapshot cache root (--snapshotDir): decoded chunks keyed by tar +
     #: decode config + this source's member filter (prefix + label file)
     snapshot_dir: str | None = None
+    #: device-resident decode (--deviceDecode): entropy pass on the host,
+    #: pixels born on-device fused into the SIFT featurize
+    device_decode: bool = False
 
     def __post_init__(self):
         self._names: list | None = None
@@ -208,6 +211,7 @@ def extract_sift_buckets(
             decode_backend=src.decode_backend,
             snapshot_dir=src.snapshot_dir,
             snapshot_extra=extra,
+            device_decode=src.device_decode,
         )
         with stream_batches(
             src.data_path, src.batch_size, keep=keep, config=cfg
@@ -532,6 +536,15 @@ def main(argv=None):
         "runs stream the shards at IO speed "
         "(KEYSTONE_SNAPSHOT_DIR equivalent)",
     )
+    p.add_argument(
+        "--deviceDecode",
+        action="store_true",
+        help="device-resident JPEG decode for --streamIngest "
+        "(ops.jpeg_device): host entropy pass only, pixels born on-device "
+        "fused into the SIFT featurize; unsupported JPEGs fall back to "
+        "host decode counted per reason (KEYSTONE_DEVICE_DECODE=1 "
+        "equivalent)",
+    )
     serve_common.add_serve_args(p)
     p.add_argument(
         "--mesh",
@@ -587,6 +600,7 @@ def main(argv=None):
             conf.train_location, conf.label_path,
             batch_size=a.streamBatchSize, autotune=a.autoTune,
             decode_backend=a.decodeBackend, snapshot_dir=a.snapshotDir,
+            device_decode=a.deviceDecode,
         )
     else:
         train = voc_loader(conf.train_location, conf.label_path)
@@ -595,6 +609,7 @@ def main(argv=None):
             conf.test_location, conf.label_path,
             batch_size=a.streamBatchSize, autotune=a.autoTune,
             decode_backend=a.decodeBackend, snapshot_dir=a.snapshotDir,
+            device_decode=a.deviceDecode,
         )
     else:
         test = voc_loader(conf.test_location, conf.label_path)

@@ -88,6 +88,13 @@ draws its parameters — fully deterministic):
   its own connection's reader — the accept loop keeps accepting, and
   concurrent well-behaved clients get every answer bit-equal and timely,
   never starved behind the stalled parser.
+* ``jpeg_corrupt_entropy`` — truncated scan data / an early marker in the
+  entropy-coded stream MID-BATCH under device decode
+  (``decode_mode="device"``, ops.jpeg_device): the damaged member becomes
+  a typed, counted skip (``jpeg_corrupt_entropy``) with the rest of the
+  batch surviving, and the streamed features equal a fault-free
+  device-decode stream over the survivors bit-for-bit — never silent
+  wrong pixels.
 """
 
 from __future__ import annotations
@@ -148,6 +155,7 @@ FAMILIES = (
     "spec_mispredict",
     "wire_disconnect",
     "slow_loris",
+    "jpeg_corrupt_entropy",
 )
 
 #: The serving-path families (core.serve / core.frontend / core.wire),
@@ -162,8 +170,8 @@ SERVE_FAMILIES = (
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(19))
-FULL_SEEDS = tuple(range(38))
+TIER1_SEEDS = tuple(range(20))
+FULL_SEEDS = tuple(range(40))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -312,6 +320,24 @@ def make_schedule(seed: int) -> Fault:
             kind,
             {"requests": int(rng.integers(6, 13)),
              "lorises": int(rng.integers(1, 3))},
+        )
+    if kind == "jpeg_corrupt_entropy":
+        k = int(rng.integers(1, 3))
+        corrupt = tuple(  # strictly mid-stream members
+            sorted(
+                int(i)
+                for i in rng.choice(
+                    np.arange(1, _N_STREAM_IMAGES - 1), k, replace=False
+                )
+            )
+        )
+        return Fault(
+            kind,
+            {
+                "corrupt": corrupt,
+                "batch": 4,
+                "mode": ("truncate", "marker")[int(rng.integers(0, 2))],
+            },
         )
     return Fault("deadline", {"seconds": 1.0})
 
@@ -599,6 +625,64 @@ def _stream_corrupt_phase(fault: Fault, tmpdir: str, seed: int) -> None:
         raise ChaosOracleError(
             "streamed features under a corrupt member differ from the "
             "fault-free stream on the surviving images"
+        )
+
+
+def _jpeg_corrupt_entropy_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """Damaged entropy-coded scan mid-batch under DEVICE decode
+    (ops.jpeg_device): headers parse, so the member reaches the entropy
+    decoder and must die there as a typed, COUNTED skip
+    (``jpeg_corrupt_entropy``) — the rest of the batch survives and the
+    streamed features equal a fault-free device-decode stream over the
+    surviving members bit-for-bit (both passes decode on-device, so
+    bit-equality is exact, not tolerance)."""
+    rng = np.random.default_rng(seed)
+    corrupt = tuple(fault.params["corrupt"])
+    batch = int(fault.params["batch"])
+    mode = fault.params["mode"]
+    tar_bad = os.path.join(tmpdir, f"chaos_jpeg_{seed}.tar")
+    names = faults.make_image_tar(
+        tar_bad, _N_STREAM_IMAGES, rng, corrupt=corrupt,
+        corrupt_fn=lambda data: faults.corrupt_jpeg_entropy(data, mode),
+    )
+    survivors = {n for i, n in enumerate(names) if i not in corrupt}
+    tar_ok = os.path.join(tmpdir, f"chaos_jpeg_{seed}_ok.tar")
+    with tarfile.open(tar_bad) as src, tarfile.open(tar_ok, "w") as dst:
+        for m in src:
+            if m.name in survivors:
+                dst.addfile(m, src.extractfile(m))
+
+    def device_cfg():
+        # snapshot pinned OFF: an ambient KEYSTONE_SNAPSHOT_DIR would turn
+        # the device-decode probe into a shard-read pass with no entropy
+        # decode to corrupt.
+        return ingest.StreamConfig.from_env(
+            decode_mode="device", snapshot_dir=""
+        )
+
+    before = counters.get("jpeg_corrupt_entropy")
+    faulted_feats, faulted_names = _stream_featurize(
+        tar_bad, batch, config=device_cfg()
+    )
+    skipped = counters.get("jpeg_corrupt_entropy") - before
+    if skipped != len(corrupt):
+        raise ChaosOracleError(
+            f"{len(corrupt)} entropy-corrupt member(s) but {skipped} "
+            "counted jpeg_corrupt_entropy skips — a damaged scan was "
+            "swallowed uncounted (or decoded into silent wrong pixels)"
+        )
+    clean_feats, clean_names = _stream_featurize(
+        tar_ok, batch, config=device_cfg()
+    )
+    if faulted_names != clean_names:
+        raise ChaosOracleError(
+            "device-decode stream lost data under entropy corruption: "
+            f"{faulted_names} != {clean_names}"
+        )
+    if not np.array_equal(faulted_feats, clean_feats):
+        raise ChaosOracleError(
+            "device-decoded features under entropy corruption differ "
+            "from the fault-free device stream on the surviving images"
         )
 
 
@@ -1289,6 +1373,10 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "stream_corrupt":
         _stream_corrupt_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "jpeg_corrupt_entropy":
+        _jpeg_corrupt_entropy_phase(fault, tmpdir, seed)
         return _run_workload(workload)
 
     if fault.kind == "stream_hang":

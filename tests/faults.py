@@ -56,6 +56,28 @@ def corrupt_jpeg(data: bytes, rng) -> bytes:
     return bytes(body[:2] + body[2:])  # SOI preserved at [:2]
 
 
+def corrupt_jpeg_entropy(data: bytes, mode: str = "truncate") -> bytes:
+    """Damage ONLY the entropy-coded scan of a baseline JPEG — every
+    header (SOF/DQT/DHT/SOS) stays intact, so a decoder that validates
+    headers engages the scan and must fail there, typed.  Two
+    deterministic modes: ``truncate`` chops the scan mid-stream (bits run
+    out inside an MCU), ``marker`` splices an early EOI into the scan
+    (the MCU count comes up short).  Both are guaranteed-detectable, so
+    the ``jpeg_corrupt_entropy`` chaos family never depends on random
+    bytes happening to form an invalid Huffman sequence."""
+    sos = data.find(b"\xff\xda")
+    if sos < 0:
+        raise ValueError("not a JPEG with an SOS marker")
+    seg_len = (data[sos + 2] << 8) | data[sos + 3]
+    scan = sos + 2 + seg_len
+    keep = scan + max(4, (len(data) - scan) // 3)
+    if mode == "truncate":
+        return data[:keep]
+    if mode == "marker":
+        return data[:keep] + b"\xff\xd9"
+    raise ValueError(f"unknown entropy corruption mode {mode!r}")
+
+
 def make_image_tar(
     path: str,
     n_images: int,
@@ -64,16 +86,24 @@ def make_image_tar(
     h: int = 48,
     w: int = 48,
     name_fmt: str = "img_{:04d}.jpg",
+    corrupt_fn=None,
 ) -> list[str]:
     """Write a tar of JPEGs; members whose index is in ``corrupt`` carry
     mangled JPEG bytes (decode must fail, mid-archive, without breaking
-    the members after them).  Returns the member names."""
+    the members after them).  ``corrupt_fn(data)`` overrides HOW a member
+    is mangled (default: :func:`corrupt_jpeg`; the ``jpeg_corrupt_entropy``
+    chaos family passes :func:`corrupt_jpeg_entropy` to damage only the
+    scan).  Returns the member names."""
     names = []
     with tarfile.open(path, "w") as tf:
         for i in range(n_images):
             data = make_jpeg_bytes(rng, h, w)
             if i in corrupt:
-                data = corrupt_jpeg(data, rng)
+                data = (
+                    corrupt_fn(data)
+                    if corrupt_fn is not None
+                    else corrupt_jpeg(data, rng)
+                )
             info = tarfile.TarInfo(name_fmt.format(i))
             info.size = len(data)
             tf.addfile(info, io.BytesIO(data))

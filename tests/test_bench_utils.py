@@ -244,3 +244,26 @@ def test_solve_at_scale_success_records_searched_plan(monkeypatch):
     assert placement["chosen"] == rep["chosen_tier"]
     assert placement["measured_seconds"] is not None
     json.dumps(out)
+
+
+def test_decode_path_breakdown_records_all_three_paths():
+    """ISSUE 13 acceptance: the jpeg_decode by-path ledger (CPU tier-1
+    scale) records host pool, device decode, and warm device-snapshot DMA
+    — with the device path inside golden tolerance of the host decoder
+    and the warm device-snapshot epoch doing ZERO host-side decode."""
+    import numpy as np
+
+    out = bench._decode_path_breakdown(
+        np.random.default_rng(0), batch=6, n_images=12, size=64
+    )
+    assert set(out) == {"host_pool", "device", "device_snapshot_warm"}
+    for path, rec in out.items():
+        assert rec["images_per_sec"] > 0, path
+        assert rec["overlap_efficiency"] > 0, path
+    dev = out["device"]
+    assert dev["entropy_decoded"] == 12 and dev["fallbacks"] == 0
+    assert dev["within_golden_tolerance"], dev["golden_max_abs_vs_host"]
+    warm = out["device_snapshot_warm"]
+    assert warm["zero_host_decode"]
+    assert warm["dma_bytes"] > 0
+    json.dumps(out)

@@ -35,6 +35,7 @@ EXPECTED_COUNTER = {
     "spec_mispredict": "autoshard_stepdown",
     "wire_disconnect": "wire_client_disconnect",
     "slow_loris": "chaos_slow_loris",
+    "jpeg_corrupt_entropy": "jpeg_corrupt_entropy",
 }
 
 
@@ -103,6 +104,11 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # partial frames must never stall the accept loop or starve honest
     # connections
     assert {"wire_disconnect", "slow_loris"} <= kinds
+    # Device-decode coverage (ISSUE 13): a damaged entropy-coded scan
+    # under decode_mode="device" must become a typed, counted skip with
+    # the rest of the batch surviving bit-equal — never silent wrong
+    # pixels
+    assert "jpeg_corrupt_entropy" in kinds
 
 
 def test_schedules_are_deterministic():

@@ -53,6 +53,35 @@ DEFAULT_METRICS: tuple = (
         "extra_metrics.jpeg_decode.snapshot.warm_read_images_per_sec",
         "higher", 0.30,
     ),
+    # ISSUE 13: the three-path decode ledger (host pool vs device decode
+    # vs warm device-snapshot DMA).  Rates are higher-is-better; overlap
+    # efficiency regressing means a path's decode/featurize pipelining
+    # broke; the device path's golden parity is lower-is-better (a LARGER
+    # divergence from the host decoder is a correctness drift, not noise).
+    (
+        "extra_metrics.jpeg_decode.by_path.host_pool.images_per_sec",
+        "higher", 0.30,
+    ),
+    (
+        "extra_metrics.jpeg_decode.by_path.device.images_per_sec",
+        "higher", 0.30,
+    ),
+    (
+        "extra_metrics.jpeg_decode.by_path.device_snapshot_warm.images_per_sec",
+        "higher", 0.30,
+    ),
+    (
+        "extra_metrics.jpeg_decode.by_path.host_pool.overlap_efficiency",
+        "higher", 0.15,
+    ),
+    (
+        "extra_metrics.jpeg_decode.by_path.device.overlap_efficiency",
+        "higher", 0.15,
+    ),
+    (
+        "extra_metrics.jpeg_decode.by_path.device.golden_max_abs_vs_host",
+        "lower", 0.50,
+    ),
     ("extra_metrics.e2e.cifar.e2e_images_per_sec", "higher", 0.25),
     ("extra_metrics.e2e.cifar.overlap_efficiency", "higher", 0.15),
     ("extra_metrics.e2e.imagenet_fv.e2e_images_per_sec", "higher", 0.25),

@@ -103,7 +103,10 @@ def main(argv=None) -> int:
             if a.stream and (
                 kind.startswith("stream_")
                 or kind
-                in ("autotune_thrash", "snapshot_corrupt", "decode_worker_kill")
+                in (
+                    "autotune_thrash", "snapshot_corrupt",
+                    "decode_worker_kill", "jpeg_corrupt_entropy",
+                )
             ):
                 return True
             return a.serve and kind in chaos.SERVE_FAMILIES

@@ -103,7 +103,9 @@ def overlap_from_spans(events: list) -> dict | None:
     decode, consume, all_ingest = [], [], []
     for ev in spans(events):
         iv = (float(ev["ts"]), float(ev["ts"]) + float(ev.get("dur", 0.0)))
-        if ev["name"] == "ingest.decode":
+        if ev["name"] in ("ingest.decode", "ingest.entropy_decode"):
+            # entropy_decode is the device-decode path's producer-side
+            # work (ops.jpeg_device): same lane, same ceiling semantics
             decode.append(iv)
         elif ev["name"] == "ingest.consume":
             consume.append(iv)
