@@ -87,6 +87,7 @@ import numpy as np
 
 from . import memory as kmem
 from . import optimize as kopt
+from . import profiler as kprof
 from . import trace
 from .resilience import counters
 
@@ -519,6 +520,7 @@ def clear_outcome_cache() -> None:
     _outcome_cache.clear()
     _ratio_cache.clear()
     _model_cache.clear()
+    _drift_model_cache.clear()
     _compact_skip.clear()
 
 
@@ -574,6 +576,81 @@ def model_rows(path: str | None = None) -> list:
     outcomes that carried a feature vector (bench drives the
     trained-on-A-predicted-on-B error from these)."""
     return list(_ratio_index(path)[2])
+
+
+# -- the HBM watermark drift calibration (ISSUE 14) ----------------------------
+
+#: path -> fitted byte-drift model (or None) — like _model_cache, read and
+#: fit once per process; fresh drift rows train the NEXT process.
+_drift_model_cache: dict[str, object] = {}
+
+
+def hbm_features(
+    argument_bytes: float,
+    temp_bytes: float,
+    output_bytes: float,
+    mesh_axes: dict | None,
+) -> dict:
+    """Featurize one program's CHARGED byte composition for the byte-drift
+    calibration — the same vector shape whether the row comes from a
+    watermark audit (``core.profiler.audit_plan``, the MemoryPlan side) or
+    a search candidate's hints (the scoring side), so train and predict
+    can never drift apart on feature semantics."""
+    charged = float(argument_bytes) + float(temp_bytes) + float(output_bytes)
+    return {
+        "kind": "hbm",
+        "log_charged": float(np.log1p(charged)),
+        "log_args": float(np.log1p(float(argument_bytes))),
+        "log_temp": float(np.log1p(float(temp_bytes))),
+        "log_out": float(np.log1p(float(output_bytes))),
+        "data_axis": float((mesh_axes or {}).get("data", 1)),
+        "model_axis": float((mesh_axes or {}).get("model", 1)),
+    }
+
+
+def drift_rows(path: str | None = None) -> list:
+    """The plan-vs-actual HBM drift evidence the log holds:
+    ``[(fingerprint, features, watermark/charged_ratio)]`` over the
+    ``outcome:"hbm_drift"`` rows ``core.profiler.audit_plan`` appends —
+    the byte-side analog of :func:`model_rows`."""
+    rows = []
+    for r in load_outcomes(path):
+        if r.get("outcome") != "hbm_drift":
+            continue
+        ratio = r.get("drift_ratio")
+        feats = r.get("features")
+        if ratio and ratio > 0 and isinstance(feats, dict) and feats:
+            rows.append((r.get("fingerprint"), feats, float(ratio)))
+    return rows
+
+
+def _drift_model(path: str | None = None):
+    """The fitted byte-drift calibration (optimize.CalibrationModel over
+    :func:`drift_rows`), or None when the log holds too little evidence —
+    same thresholds as the time model, and the same empty-log guarantee:
+    no drift rows means factor 1.0 everywhere, so an untrained search
+    still reproduces the hand ladder bit-for-bit."""
+    key = path if path is not None else (plan_log_path() or "")
+    if key in _drift_model_cache:
+        return _drift_model_cache[key]
+    rows = drift_rows(path)
+    model = None
+    if (
+        len(rows) >= kopt.MIN_MODEL_ROWS
+        and len({fp for fp, _f, _r in rows}) >= 2
+    ):
+        model = kopt.CalibrationModel.fit_rows(rows)
+    _drift_model_cache[key] = model
+    return model
+
+
+def drift_factor(features: dict, path: str | None = None) -> float:
+    """Predicted watermark/charged ratio for one byte-composition feature
+    vector (1.0 with no trained model)."""
+    model = _drift_model(path)
+    if model is None:
+        return 1.0
+    return model.predict_factor(features)
 
 
 def _cross_program_model(path: str | None):
@@ -713,6 +790,9 @@ class CandidateRecord:
     samples: int = 0  #: DIRECT measured outcomes behind the calibration
     #: which rung produced the factor: "direct" | "model" | "pooled" | "none"
     calibration_source: str = "none"
+    #: watermark-drift calibration applied to the scored temp bytes
+    #: (1.0 = no trained byte-drift model; see autoshard.drift_factor)
+    byte_drift: float = 1.0
     rank: int | None = None  #: position in the execution ranking
     measured_seconds: float | None = None  #: filled when this plan RAN
     outcome: str | None = None  #: "ok" | "oom" | "denied" after the run
@@ -901,8 +981,25 @@ def search(
                 continue
             # 2. score: analytic roofline prior x learned calibration
             # (direct median, else the cross-program feature regression,
-            # else the program-pooled median — see calibrate()).
-            raw = model.predict_seconds(c.hints)
+            # else the program-pooled median — see calibrate()).  The
+            # scored TEMP bytes first pass through the byte-drift
+            # calibration learned from HBM watermark audits
+            # (core.profiler.audit_plan rows): a program family whose
+            # transients the analytic floor consistently under-charges
+            # scores its real HBM traffic.  Factor 1.0 (exact) with no
+            # trained drift model — the empty-log bit-for-bit guarantee.
+            hints = c.hints
+            dfac = drift_factor(hbm_features(
+                hints.get("arg_bytes", 0),
+                hints.get("temp_bytes", 0),
+                hints.get("out_bytes", 0),
+                c.mesh_axes,
+            ))
+            if dfac != 1.0:
+                hints = dict(hints)
+                hints["temp_bytes"] = hints.get("temp_bytes", 0) * dfac
+            rec.byte_drift = round(dfac, 4)
+            raw = model.predict_seconds(hints)
             feats = plan_features(c.kind, c.mesh_axes, c.hints)
             factor, samples, source = calibrate(
                 fingerprint, c.name, features=feats
@@ -1031,6 +1128,7 @@ def run_search(
     counts every step off the top-ranked plan under ``autoshard_stepdown``.
     """
     do_search, forced = _resolve(plan)
+    report.fingerprint = fingerprint
     by_prior = sorted(candidates, key=lambda c: c.prior_rank)
     if not do_search:
         tiers = [
@@ -1106,6 +1204,22 @@ def run_search(
                     measured[c.name] = time.perf_counter() - t0
                     raise
             measured[c.name] = time.perf_counter() - t0
+            if kprof.enabled() and mplan is not None:
+                # Audit the hand-derived flops hint against the compiled
+                # program's own cost_analysis (ISSUE 14): single-device
+                # candidates only — SPMD modules report per-device numbers
+                # whose hint mapping is mesh-dependent, and a misleading
+                # audit would be worse than none.  Mismatch beyond the
+                # tolerance factor is counted, never silent.
+                chips = 1
+                for v in (c.mesh_axes or {}).values():
+                    chips *= int(v)
+                if chips == 1:
+                    kprof.audit_flops(
+                        f"{label}:{c.name}",
+                        c.hints.get("flops"),
+                        getattr(mplan, "compiled", None),
+                    )
             return out
 
         return kmem.Tier(c.name, plan_fn, run)

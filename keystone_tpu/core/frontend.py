@@ -695,10 +695,19 @@ class ShapeRouter:
             }
             stats = self.stats.record()
             admissions = list(self.admissions)
-        return {
+        out = {
             "label": self.label,
             "config": self.config.record(),
             "engines": engines,
             "stats": stats,
             "admissions": admissions,
         }
+        from . import profiler as kprof
+
+        if kprof.enabled():
+            # Device cost attribution (ISSUE 14): with the profiler on,
+            # the router record carries the per-program MFU ledger — the
+            # per-shape serve buckets' roofline positions land in every
+            # serving artifact that embeds the router.
+            out["profiler"] = kprof.ledger_record()
+        return out

@@ -811,7 +811,18 @@ class StreamBatch:
         never materialized between two dispatches), a plain call on the
         device pixel batch otherwise."""
         if self.coeff is None:
-            return transform(self.dev())
+            from . import profiler as kprof
+
+            if not kprof.enabled():
+                return transform(self.dev())
+            # Per-program MFU attribution of the featurize dispatch
+            # (ISSUE 14).  Values unchanged; pipelining traded for
+            # measurement only while the profiler is ON.
+            dev = self.dev()
+            return kprof.attributed_call(
+                f"featurize:{self.shape[0]}x{self.shape[1]}",
+                tuple(np.shape(dev)), transform, dev,
+            )
         from ..ops import jpeg_device as jdev
 
         coeffs, qt = self.coeff.arrays()

@@ -49,10 +49,12 @@ import dataclasses
 import logging
 import os
 import re
+import time
 from typing import Any, Callable, Sequence
 
 import jax
 
+from . import profiler
 from . import trace
 from .resilience import counters
 
@@ -369,7 +371,12 @@ def plan_program(
         budget, _worst = min_chip_budget(mesh)
     if budget is _UNSET:
         budget = hbm_budget()
-    if budget is None and not require_analysis:
+    # With the profiler ON the zero-cost skip still compiles (ISSUE 14):
+    # the cost-attribution ledger and the flops audit need the compiled
+    # executable's cost_analysis, and the compile is work the admitted
+    # tier was about to do anyway (plan.compiled is what executes).
+    # Admission itself stays skipped — budget None never denies.
+    if budget is None and not require_analysis and not profiler.enabled():
         return _admission_event(MemoryPlan(
             label=label,
             admitted=True,
@@ -407,6 +414,22 @@ def plan_program(
             cached = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     if cached["error"] is not None:
+        if budget is None and not require_analysis:
+            # The compile only happened because the PROFILER asked for
+            # attribution (the budget-less skip above) — attribution is
+            # advisory, so its failure must admit exactly like the
+            # unprofiled skip would: enabling the profiler can never
+            # deny a tier an unprofiled run would have executed.
+            return _admission_event(MemoryPlan(
+                label=label,
+                admitted=True,
+                reason=(
+                    "no HBM budget known — admission skipped (profiler "
+                    f"attribution compile failed: {cached['error'][:120]})"
+                ),
+                mesh_axes=dict(mesh.shape) if mesh is not None else None,
+                error=cached["error"],
+            ))
         plan = MemoryPlan(
             label=label,
             admitted=False,
@@ -726,6 +749,11 @@ class FitReport:
     chosen: str | None = None
     denials: list = dataclasses.field(default_factory=list)
     oom_retries: list = dataclasses.field(default_factory=list)
+    #: the placement search's program fingerprint (set by
+    #: autoshard.run_search) — the grouping key the profiler's HBM
+    #: watermark drift rows use, so byte-drift evidence joins the same
+    #: program family as the time outcomes.
+    fingerprint: str | None = None
     #: mesh ladders: the (data, model) axis sizes of the mesh that actually
     #: RAN the solve; ``None`` after a step-down to the single-device floor
     #: (and for plain single-device fits).
@@ -809,7 +837,8 @@ def run_ladder(label: str, tiers: Sequence[Tier], report: FitReport):
                 with trace.span(
                     f"tier:{tier.name}", cat="solve",
                     solve=label, admitted=plan.admitted,
-                ):
+                ), profiler.phase(f"solve:{label}"):
+                    t_run = time.perf_counter()
                     out = tier.run(plan)
             except Exception as e:  # noqa: BLE001 — only OOM is retried
                 if not is_oom_error(e) or floor:
@@ -826,6 +855,23 @@ def run_ladder(label: str, tiers: Sequence[Tier], report: FitReport):
             if report.degraded() or tier.name != tiers[0].name:
                 counters.record("solver_tier_degraded", report.summary())
             _logger.info("%s: running tier=%s (%s)", label, tier.name, plan.reason)
+            if profiler.enabled():
+                # Device cost attribution (ISSUE 14): the chosen tier's
+                # compiled program lands in the per-program MFU ledger
+                # with its device-synced wall, and the HBM watermark the
+                # sampler saw during the solve is audited against what
+                # this plan CHARGED — drift is counted and logged as
+                # calibration evidence.  One enabled() check when off.
+                wall = profiler.synced_wall(out, t_run)
+                if plan.compiled is not None:
+                    profiler.record_program(
+                        f"{label}:{tier.name}", plan.compiled, wall
+                    )
+                profiler.audit_plan(
+                    f"{label}:{tier.name}", plan,
+                    phase_name=f"solve:{label}",
+                    fingerprint=report.fingerprint,
+                )
             solve_sp.set(report=report.record())
             return out
         # Unreachable in practice (the floor either returns or raises), but

@@ -91,6 +91,7 @@ from typing import Any, Callable, Sequence
 import numpy as np
 
 from . import memory as kmem
+from . import profiler as kprof
 from . import telemetry
 from . import trace
 from .resilience import counters
@@ -452,6 +453,17 @@ class ServingEngine:
             )
         return ex(self._pipe, dev_batch)
 
+    def _profile_bucket(self, bucket: int, wall_seconds: float) -> None:
+        """Ledger hook (core.profiler): one synced bucket execution's MFU
+        attribution, keyed ``serve:<label>:b<bucket>``.  Caller gates on
+        ``profiler.enabled()`` — this is never on the off path."""
+        plan = self.memory_plans.get(bucket)
+        kprof.record_program(
+            f"serve:{self.label}:b{bucket}",
+            plan.compiled if plan is not None else None,
+            wall_seconds,
+        )
+
     def _retire_bucket(self, bucket: int, why: str) -> None:
         with self._lock:
             self._exec.pop(bucket, None)
@@ -505,10 +517,16 @@ class ServingEngine:
         ):
             dev = self._jax.device_put(padded)
         try:
+            t_exec = time.perf_counter()
             with trace.span(
                 "serve.execute", cat="serve", bucket=bucket, rows=k
             ) as sp:
                 out = sp.sync(self._execute(bucket, dev))
+            if kprof.enabled():
+                # Per-bucket MFU ledger entry (ISSUE 14): the synced
+                # execute wall against the very executable the preflight
+                # planned.  One enabled() check when the profiler is off.
+                self._profile_bucket(bucket, time.perf_counter() - t_exec)
         except Exception as e:  # noqa: BLE001 — only OOM degrades
             # A concurrent caller can retire this bucket between
             # bucket_for() and _execute(); rows re-route below exactly
@@ -963,6 +981,8 @@ class Server:
                 ) as sp:
                     out = sp.sync(self.engine._execute(bucket, dev))
                 t_exec = time.perf_counter()
+                if kprof.enabled():
+                    self.engine._profile_bucket(bucket, t_exec - t_exec_start)
                 with trace.io_span(
                     "serve.d2h",
                     int(getattr(out, "nbytes", 0)), cat="serve", bucket=bucket,

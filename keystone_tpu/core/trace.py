@@ -133,6 +133,15 @@ def _now_us() -> float:
     return (time.perf_counter() - _EPOCH) * 1e6
 
 
+def now_us() -> float:
+    """The trace clock: microseconds since this module's import — the
+    ``ts`` origin every span/instant uses.  Public for the wire protocol's
+    clock-offset handshake (core.wire ``T_CLOCK``): two processes exchange
+    their trace clocks so ``tools/trace_view.py --stitch`` can align a
+    client's timeline with the server's."""
+    return _now_us()
+
+
 def _tid() -> int:
     """Small sequential id for the calling thread; first sight also emits
     the Chrome ``thread_name`` metadata event so Perfetto labels lanes."""

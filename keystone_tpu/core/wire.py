@@ -87,6 +87,15 @@ T_ERROR = 3
 T_RETRY_AFTER = 4
 T_PING = 5
 T_PONG = 6
+#: clock-offset handshake (ISSUE 14 cross-process stitching): the client
+#: sends its trace clock (``trace.now_us``), the server echoes it with its
+#: OWN trace clock appended — offset = server - (client + rtt/2).
+T_CLOCK = 7
+#: REQUEST with a trace-context prefix (u64 client span id before the
+#: array body): the server's ``wire.request`` instant records the
+#: client-side span the request rode in, so ``trace_view --stitch`` can
+#: join the two processes' timelines by more than the rid alone.
+T_REQUEST_TRACED = 8
 
 _LEN = struct.Struct("!I")
 _HEAD = struct.Struct("!BBQ")  # version, type, request_id
@@ -94,6 +103,9 @@ _NDIM = struct.Struct("!B")
 _U16 = struct.Struct("!H")
 _DIM = struct.Struct("!I")
 _RETRY = struct.Struct("!d")
+_CLOCK = struct.Struct("!d")  # one trace-clock sample (us)
+_CLOCK2 = struct.Struct("!dd")  # client clock echoed + server clock
+_SPAN = struct.Struct("!Q")  # trace-context prefix: client span id
 
 DEFAULT_MAX_INFLIGHT = 32
 DEFAULT_MAX_FRAME_MB = 64
@@ -216,6 +228,57 @@ def decode_error(body) -> tuple[str, str]:
     except (struct.error, UnicodeDecodeError) as e:
         raise WireProtocolError(f"truncated error body: {e}") from None
     return etype, msg
+
+
+def encode_clock(rid: int, t_client_us: float) -> bytes:
+    """Client -> server clock-sync probe carrying the client trace clock."""
+    return encode_frame(T_CLOCK, rid, _CLOCK.pack(float(t_client_us)))
+
+
+def encode_clock_reply(
+    rid: int, t_client_us: float, t_server_us: float
+) -> bytes:
+    """Server -> client clock-sync echo: the probe's clock + the server's."""
+    return encode_frame(
+        T_CLOCK, rid, _CLOCK2.pack(float(t_client_us), float(t_server_us))
+    )
+
+
+def decode_clock(body) -> float:
+    try:
+        (t,) = _CLOCK.unpack_from(memoryview(body), 0)
+    except struct.error as e:
+        raise WireProtocolError(f"truncated clock body: {e}") from None
+    return t
+
+
+def decode_clock_reply(body) -> tuple[float, float]:
+    try:
+        tc, ts = _CLOCK2.unpack_from(memoryview(body), 0)
+    except struct.error as e:
+        raise WireProtocolError(f"truncated clock reply: {e}") from None
+    return tc, ts
+
+
+def encode_traced_request(rid: int, client_span: int, arr) -> bytes:
+    """REQUEST with the optional trace-context field: the client's span id
+    rides ahead of the array body (old servers answer an ERROR frame for
+    the unknown type — a traced client degrades to plain REQUESTs)."""
+    return encode_frame(
+        T_REQUEST_TRACED,
+        rid,
+        _SPAN.pack(int(client_span)) + encode_array(np.asarray(arr)),
+    )
+
+
+def split_trace_context(body) -> tuple[int, memoryview]:
+    """``(client_span, array_body)`` of a T_REQUEST_TRACED payload."""
+    body = memoryview(body)
+    try:
+        (span,) = _SPAN.unpack_from(body, 0)
+    except struct.error as e:
+        raise WireProtocolError(f"truncated trace context: {e}") from None
+    return int(span), body[_SPAN.size:]
 
 
 def decode_retry_after(body) -> tuple[float, str]:
@@ -447,7 +510,21 @@ class WireServer:
         if ftype == T_PING:
             self._send(conn, encode_frame(T_PONG, rid))
             return
-        if ftype != T_REQUEST:
+        if ftype == T_CLOCK:
+            # Clock-offset handshake (cross-process stitching): echo the
+            # client's trace clock with ours appended — the client
+            # estimates offset = server - (client + rtt/2) and records it
+            # in its own trace so --stitch can align the two timelines.
+            try:
+                t_client = decode_clock(body)
+            except WireProtocolError as e:
+                self._send(conn, encode_error(rid, "WireProtocolError", str(e)))
+                return
+            self._send(
+                conn, encode_clock_reply(rid, t_client, trace.now_us())
+            )
+            return
+        if ftype not in (T_REQUEST, T_REQUEST_TRACED):
             with self._lock:
                 self.stats.protocol_errors += 1
             self._send(conn, encode_error(
@@ -459,7 +536,10 @@ class WireServer:
         with self._lock:
             self.stats.requests += 1
         trace.metrics.inc("wire_requests")
+        client_span = None
         try:
+            if ftype == T_REQUEST_TRACED:
+                client_span, body = split_trace_context(body)
             arr = decode_array(body)
         except WireProtocolError as e:
             with self._lock:
@@ -504,10 +584,12 @@ class WireServer:
             self._send(conn, encode_error(rid, type(e).__name__, str(e)))
             return
         # The wire id <-> serve id tie: every serve.* span of this request
-        # correlates back to the connection that carried it.
+        # correlates back to the connection that carried it (and, for a
+        # traced client, to the CLIENT-side span it rode in).
         trace.instant(
             "wire.request", conn=conn.cid, wire_rid=rid,
             request_id=getattr(fut, "request_id", 0),
+            **({"client_span": client_span} if client_span is not None else {}),
         )
         with conn.cond:
             conn.queue.append((rid, fut, t0))
@@ -704,6 +786,8 @@ class WireReply:
     etype: str | None = None
     message: str | None = None
     retry_after_s: float | None = None
+    #: T_CLOCK reply: (client trace clock echoed, server trace clock) us
+    clock: tuple | None = None
 
 
 class WireClient:
@@ -730,14 +814,54 @@ class WireClient:
         self._buf = bytearray()
         self._next_id = 0
 
-    def submit(self, arr) -> int:
-        """Send one REQUEST frame; returns its wire request id."""
+    def submit(self, arr, client_span: int | None = None) -> int:
+        """Send one REQUEST frame; returns its wire request id.
+        ``client_span`` rides as the optional trace-context field
+        (T_REQUEST_TRACED) so the server's ``wire.request`` instant names
+        the client-side span this request belongs to."""
         self._next_id += 1
         rid = self._next_id
-        self._sock.sendall(
-            encode_frame(T_REQUEST, rid, encode_array(np.asarray(arr)))
-        )
+        if client_span is not None:
+            self._sock.sendall(encode_traced_request(rid, client_span, arr))
+        else:
+            self._sock.sendall(
+                encode_frame(T_REQUEST, rid, encode_array(np.asarray(arr)))
+            )
         return rid
+
+    def clock_sync(self, samples: int = 5) -> dict | None:
+        """Estimate the server-trace-clock offset: ``samples`` T_CLOCK
+        round trips, keeping the minimum-RTT one (the least queue-skewed
+        estimate).  Returns ``{"offset_us", "rtt_us"}`` — add ``offset_us``
+        to a client trace timestamp to land on the server's timeline — or
+        None when the server predates the handshake (it answers the
+        unknown frame type with an ERROR; the client degrades, it does
+        not die)."""
+        from . import trace as ktrace
+
+        best = None
+        for _ in range(max(1, samples)):
+            self._next_id += 1
+            rid = self._next_id
+            t0 = ktrace.now_us()
+            self._sock.sendall(encode_clock(rid, t0))
+            reply = self.read()
+            t1 = ktrace.now_us()
+            if reply.type == T_ERROR:
+                return None  # pre-handshake server — degrade quietly
+            if reply.type != T_CLOCK or reply.request_id != rid:
+                raise WireProtocolError(
+                    f"expected CLOCK {rid}, got type {reply.type} "
+                    f"id {reply.request_id}"
+                )
+            t_client, t_server = reply.clock
+            rtt = t1 - t_client
+            offset = t_server - (t_client + rtt / 2.0)
+            if best is None or rtt < best["rtt_us"]:
+                best = {
+                    "offset_us": round(offset, 1), "rtt_us": round(rtt, 1)
+                }
+        return best
 
     def ping(self) -> float:
         """Round-trip one PING; returns seconds."""
@@ -777,6 +901,8 @@ class WireClient:
         if ftype == T_RETRY_AFTER:
             seconds, msg = decode_retry_after(body)
             return WireReply(ftype, rid, retry_after_s=seconds, message=msg)
+        if ftype == T_CLOCK:
+            return WireReply(ftype, rid, clock=decode_clock_reply(body))
         return WireReply(ftype, rid)
 
     def predict(self, arr, timeout: float = 30.0) -> np.ndarray:

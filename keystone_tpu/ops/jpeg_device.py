@@ -850,4 +850,17 @@ def fused_apply(transform, geom: JpegGeometry, coeffs, qt, *,
     fused, admitted = entry
     if not admitted:
         return transform(decode_batch(geom, coeffs, qt))
-    return fused(tuple(coeffs), qt)
+    from ..core import profiler as kprof
+
+    if not kprof.enabled():
+        return fused(tuple(coeffs), qt)
+    # Device cost attribution (ISSUE 14): the fused decode+featurize
+    # dispatch lands in the per-program MFU ledger with a synced wall
+    # (cost memoized per (fused jit, geometry)).  Syncing serializes the
+    # consumer's double buffer for this chunk — profiling costs
+    # pipelining, never correctness (values unchanged; the
+    # profiler_crash chaos family pins bit-equality).
+    return kprof.attributed_call(
+        f"fused_decode:{label}:{geom.height}x{geom.width}",
+        geom, fused, tuple(coeffs), qt,
+    )

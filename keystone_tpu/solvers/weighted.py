@@ -47,6 +47,7 @@ import dataclasses
 
 from ..core import autoshard
 from ..core import memory as kmem
+from ..core import profiler as kprof
 from ..core import trace
 from ..core.pipeline import LabelEstimator
 from ..core.resilience import counters
@@ -1069,15 +1070,18 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             },
             prior_rank=len(cands), floor=True,
         ))
-        out = autoshard.run_search(
-            "bwls_fit", cands, report,
-            fingerprint=autoshard.fingerprint(
-                "bwls_fit", n, n_classes, n_max, widths, self.num_iter,
-                self.class_chunk, str(xdt), str(dtype), dict(mesh.shape),
-                autoshard.device_fingerprint(),
-            ),
-            plan=plan_arg,
-        )
+        # Profiler phase (core.profiler): the watermark sampler attributes
+        # this solve's HBM high-water mark to "bwls_fit".  No-op when off.
+        with kprof.phase("bwls_fit"):
+            out = autoshard.run_search(
+                "bwls_fit", cands, report,
+                fingerprint=autoshard.fingerprint(
+                    "bwls_fit", n, n_classes, n_max, widths, self.num_iter,
+                    self.class_chunk, str(xdt), str(dtype), dict(mesh.shape),
+                    autoshard.device_fingerprint(),
+                ),
+                plan=plan_arg,
+            )
         if inner_chosen and report.chosen == "single_device":
             report.chosen = f"single_device/{inner_chosen[0]}"
         return out
@@ -1334,13 +1338,14 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 prior_rank=2, floor=True,
             ),
         ]
-        return autoshard.run_search(
-            "bwls_fit", cands, report,
-            fingerprint=autoshard.fingerprint(
-                "bwls_fit", n, n_classes, n_max, widths, self.num_iter,
-                self.class_chunk, str(xdt), str(dtype), None,
-                autoshard.device_fingerprint(),
-            ),
-            plan=plan_arg,
-            budget=budget,
-        )
+        with kprof.phase("bwls_fit"):
+            return autoshard.run_search(
+                "bwls_fit", cands, report,
+                fingerprint=autoshard.fingerprint(
+                    "bwls_fit", n, n_classes, n_max, widths, self.num_iter,
+                    self.class_chunk, str(xdt), str(dtype), None,
+                    autoshard.device_fingerprint(),
+                ),
+                plan=plan_arg,
+                budget=budget,
+            )

@@ -95,6 +95,12 @@ draws its parameters — fully deterministic):
   batch surviving, and the streamed features equal a fault-free
   device-decode stream over the survivors bit-for-bit — never silent
   wrong pixels.
+* ``profiler_crash`` — the device cost-attribution layer's HBM watermark
+  sampler thread (core.profiler) is killed MID-RUN by an injected stats
+  failure: the crash is a counted degradation (``profiler_sampler_crash``),
+  the profiled run COMPLETES, and its outputs are bit-equal to an
+  unprofiled run — observability may die, the workload may not, and a
+  dead profiler must never change a single bit of the answer.
 """
 
 from __future__ import annotations
@@ -156,6 +162,7 @@ FAMILIES = (
     "wire_disconnect",
     "slow_loris",
     "jpeg_corrupt_entropy",
+    "profiler_crash",
 )
 
 #: The serving-path families (core.serve / core.frontend / core.wire),
@@ -170,8 +177,8 @@ SERVE_FAMILIES = (
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(20))
-FULL_SEEDS = tuple(range(40))
+TIER1_SEEDS = tuple(range(21))
+FULL_SEEDS = tuple(range(42))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -339,6 +346,11 @@ def make_schedule(seed: int) -> Fault:
                 "mode": ("truncate", "marker")[int(rng.integers(0, 2))],
             },
         )
+    if kind == "profiler_crash":
+        return Fault(
+            kind,
+            {"batch": 4, "crash_after": int(rng.integers(1, 5))},
+        )
     return Fault("deadline", {"seconds": 1.0})
 
 
@@ -500,10 +512,14 @@ def _patched(obj, attr, replacement):
 @contextlib.contextmanager
 def _clean_env():
     """Chaos runs start from the default resilience posture: no HBM budget
-    override (ladders start at the fused tier) and the numerics guard on."""
+    override (ladders start at the fused tier), the numerics guard on, and
+    the profiler OFF (profiler_crash enables it itself, scoped)."""
     saved = {
         k: os.environ.pop(k, None)
-        for k in (kmem.HBM_BUDGET_ENV, "KEYSTONE_NUMERICS_GUARD")
+        for k in (
+            kmem.HBM_BUDGET_ENV, "KEYSTONE_NUMERICS_GUARD",
+            "KEYSTONE_PROFILER",
+        )
     }
     try:
         yield
@@ -683,6 +699,67 @@ def _jpeg_corrupt_entropy_phase(fault: Fault, tmpdir: str, seed: int) -> None:
         raise ChaosOracleError(
             "device-decoded features under entropy corruption differ "
             "from the fault-free device stream on the surviving images"
+        )
+
+
+def _profiler_crash_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """The HBM watermark sampler thread (core.profiler) dies MID-RUN from
+    an injected stats failure: the crash must be a counted degradation
+    (``profiler_sampler_crash``), the profiled run must COMPLETE, and its
+    streamed features must be bit-equal to an unprofiled run — a dead
+    observability thread may cost telemetry, never correctness."""
+    from keystone_tpu.core import profiler as kprof
+
+    rng = np.random.default_rng(seed)
+    batch = int(fault.params["batch"])
+    crash_after = int(fault.params["crash_after"])
+    tar_path = os.path.join(tmpdir, f"chaos_prof_{seed}.tar")
+    faults.make_image_tar(tar_path, _N_STREAM_IMAGES, rng)
+
+    # The unprofiled oracle (the default posture: profiler off).
+    base_feats, base_names = _stream_featurize(tar_path, batch)
+
+    calls = {"n": 0}
+
+    def crashing_stats():
+        calls["n"] += 1
+        if calls["n"] > crash_after:
+            raise RuntimeError("injected HBM sampler crash")
+        return 123 * 2**20  # a plausible bytes-in-use figure until then
+
+    before = counters.get("profiler_sampler_crash")
+    kprof.reset_state()
+    try:
+        with kprof.profiled(
+            True, interval_ms=1.0, stats_fn=crashing_stats
+        ):
+            feats, names = _stream_featurize(tar_path, batch)
+            # The thread polls every 1ms — wait (bounded) for the injected
+            # crash to land so the count below is deterministic.
+            s = kprof.sampler()
+            end = time.monotonic() + 5.0
+            while (
+                s is not None and not s.crashed and time.monotonic() < end
+            ):
+                time.sleep(0.01)
+    finally:
+        kprof.reset_state()
+    crashed = counters.get("profiler_sampler_crash") - before
+    if crashed != 1:
+        raise ChaosOracleError(
+            f"sampler crash injected but {crashed} counted "
+            "profiler_sampler_crash — a dead profiler thread went "
+            "unnoticed (or died more than once)"
+        )
+    if names != base_names:
+        raise ChaosOracleError(
+            "profiled stream lost data under a sampler crash: "
+            f"{names} != {base_names}"
+        )
+    if not np.array_equal(feats, base_feats):
+        raise ChaosOracleError(
+            "profiled features differ from the unprofiled run — the "
+            "cost-attribution layer changed the answer"
         )
 
 
@@ -1377,6 +1454,10 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "jpeg_corrupt_entropy":
         _jpeg_corrupt_entropy_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "profiler_crash":
+        _profiler_crash_phase(fault, tmpdir, seed)
         return _run_workload(workload)
 
     if fault.kind == "stream_hang":

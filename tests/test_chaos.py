@@ -36,6 +36,7 @@ EXPECTED_COUNTER = {
     "wire_disconnect": "wire_client_disconnect",
     "slow_loris": "chaos_slow_loris",
     "jpeg_corrupt_entropy": "jpeg_corrupt_entropy",
+    "profiler_crash": "profiler_sampler_crash",
 }
 
 
@@ -53,7 +54,7 @@ def _check(r):
 def test_chaos_schedule_mnist(seed, tmp_path):
     """Every tier-1 schedule runs TRACED and its trace is held to the
     never-silent bar (the ``chaos_run.py --trace`` invariant, extended
-    from the original 10 families to all 19): every counted fault appears
+    from the original 10 families to all 21): every counted fault appears
     as a kind-tagged ``fault`` instant, every typed error as a failed
     span or fault event."""
     trace_path = str(tmp_path / f"chaos_seed{seed}.json")
@@ -109,6 +110,11 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # the rest of the batch surviving bit-equal — never silent wrong
     # pixels
     assert "jpeg_corrupt_entropy" in kinds
+    # Profiler coverage (ISSUE 14): the HBM watermark sampler thread
+    # dying mid-run must be a counted degradation with the run completing
+    # bit-equal to an unprofiled run — observability may die, the
+    # workload may not
+    assert "profiler_crash" in kinds
 
 
 def test_schedules_are_deterministic():

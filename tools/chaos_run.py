@@ -22,7 +22,7 @@ appear in its schedule's trace as a counted ``fault`` instant event with a
 matching ``kind`` attribute, and a typed-error outcome must be visible as a
 failed span carrying the error type — typed-error spans are never silent.
 A schedule whose trace misses either fails the run like any other
-violation.  The assertion covers ALL 19 fault families (the streaming,
+violation.  The assertion covers ALL 21 fault families (the streaming,
 snapshot, decode-worker, serving, wire-protocol, and placement families
 included) and the tier-1 suite runs every schedule traced
 (tests/test_chaos.py), so the invariant is continuously enforced, not just

@@ -56,10 +56,23 @@ def _percentile(sorted_ms, q):
     return float(sorted_ms[min(len(sorted_ms) - 1, int(q * len(sorted_ms)))])
 
 
-def drive(client, requests, window: int, timeout: float) -> dict:
+def drive(client, requests, window: int, timeout: float,
+          traced: bool = False, trace_context: bool | None = None) -> dict:
     """Pipelined open-loop drive with per-request latency accounting:
     ``window`` outstanding wire requests; RETRY_AFTER resubmits keep the
-    original submit time (backpressure IS latency the client felt)."""
+    original submit time (backpressure IS latency the client felt).
+
+    ``traced=True`` emits the client's OWN trace events (``client.submit``
+    / ``client.answer`` instants keyed by wire rid) — the client half
+    ``tools/trace_view.py --stitch`` joins with the server trace into one
+    request waterfall.  ``trace_context`` additionally sends each request
+    as a T_REQUEST_TRACED frame carrying the client span id; pass False
+    against a pre-handshake server (it would answer the unknown frame
+    type with an ERROR) — the caller gates it on ``clock_sync()``
+    succeeding."""
+    if trace_context is None:
+        trace_context = traced
+    from keystone_tpu.core import trace as ktrace
     from keystone_tpu.core import wire
 
     n = len(requests)
@@ -74,19 +87,33 @@ def drive(client, requests, window: int, timeout: float) -> dict:
         if time.perf_counter() >= end:
             raise TimeoutError(f"{done}/{n} answered within {timeout}s")
         while next_i < n and len(t_submit) < max(1, window):
-            rid = client.submit(requests[next_i])
+            rid = client.submit(
+                requests[next_i],
+                client_span=next_i if trace_context else None,
+            )
+            if traced:
+                ktrace.instant("client.submit", rid=rid, span=next_i)
             t_submit[rid] = (next_i, time.perf_counter())
             next_i += 1
         reply = client.read()
         if reply.type == wire.T_RESPONSE:
             idx, t0 = t_submit.pop(reply.request_id)
             latencies[idx] = (time.perf_counter() - t0) * 1e3
+            if traced:
+                ktrace.instant(
+                    "client.answer", rid=reply.request_id, span=idx,
+                    ms=round(latencies[idx], 3),
+                )
             done += 1
         elif reply.type == wire.T_RETRY_AFTER:
             idx, t0 = t_submit.pop(reply.request_id)
             retries += 1
             time.sleep(min(max(reply.retry_after_s or 0.0, 0.0), 1.0))
-            rid = client.submit(requests[idx])
+            rid = client.submit(
+                requests[idx], client_span=idx if trace_context else None
+            )
+            if traced:
+                ktrace.instant("client.submit", rid=rid, span=idx, retry=True)
             t_submit[rid] = (idx, t0)  # latency spans the pushback too
         elif reply.type == wire.T_ERROR:
             raise wire.WireRemoteError(reply.etype, reply.message or "")
@@ -133,17 +160,51 @@ def main(argv=None) -> int:
     p.add_argument("--window", type=int, default=8)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument(
+        "--trace", default=None, metavar="OUT.jsonl",
+        help="write the client's own JSONL trace (client.submit/"
+        "client.answer instants + the clock-offset handshake) for "
+        "tools/trace_view.py --stitch",
+    )
     a = p.parse_args(argv)
 
+    from keystone_tpu.core import trace as ktrace
     from keystone_tpu.core.wire import WireClient
 
     shape = parse_shape(a.shape)
     rng = np.random.default_rng(a.seed)
     requests = rng.standard_normal((a.requests, *shape)).astype(a.dtype)
 
+    clock = None
+    if a.trace:
+        ktrace.enable(a.trace)
     with WireClient(a.host, a.port, timeout=a.timeout) as client:
         rtt = client.ping()
-        record = drive(client, list(requests), a.window, a.timeout)
+        if a.trace:
+            # Clock-offset handshake BEFORE the load: the offset meta
+            # event is what lets --stitch place server spans on the
+            # client's timeline (and vice versa).
+            clock = client.clock_sync()
+            ktrace.instant(
+                "client.clock",
+                **(clock if clock is not None else {"unsupported": True}),
+            )
+        record = drive(
+            client, list(requests), a.window, a.timeout,
+            traced=bool(a.trace),
+            # A pre-handshake server answered the T_CLOCK probe with an
+            # ERROR (clock None): it would do the same to every
+            # T_REQUEST_TRACED — degrade to plain REQUESTs, keep the
+            # client-side trace.
+            trace_context=bool(a.trace) and clock is not None,
+        )
+    if a.trace:
+        ktrace.flush()
+        ktrace.disable()
+        record["trace"] = a.trace
+        record["clock_offset_us"] = (
+            clock.get("offset_us") if clock else None
+        )
     record.update(
         metric="serve_client",
         host=a.host,
