@@ -1909,8 +1909,19 @@ def bench_serving(rng):
     engines = {}
 
     def slo(pipe, example, requests, label):
+        from keystone_tpu.core import numerics as kbnum
+
         stem = os.path.join(tmp, f"{label}_pipe")
-        save_pipeline(stem, pipe)
+        # Fit-time output baseline in the manifest (ISSUE 15): load_engine
+        # arms the drift monitor from it, so the serving records carry a
+        # real drift verdict (the benched mix IS the fit mix — divergence
+        # ~0 is the healthy reading).
+        save_pipeline(
+            stem, pipe,
+            numerics_baseline=kbnum.OutputSketch.for_outputs(
+                np.asarray(pipe(jnp.asarray(requests)))
+            ).record(),
+        )
         engine, cold = kserve.load_engine(
             stem, example, config=cfg, label=label
         )
@@ -1969,84 +1980,98 @@ def bench_serving(rng):
             "cifar_conv",
         )
 
-        # -- telemetry overhead (ISSUE 11 acceptance: < 2% p99) ---------------
-        # The SAME warm engine serves the same request set twice: once with
-        # the telemetry tier off (flight ring depth 0, SLO observation
-        # suspended), once with it on — the p99 ratio IS the overhead of
-        # the always-on production telemetry.
+        # -- observability overhead probes ------------------------------------
+        # ONE harness, three tiers: the SAME warm engine serves the same
+        # request set with a tier off, then on — the p99 ratio IS that
+        # tier's cost on a live endpoint.  Telemetry (ISSUE 11, < 2%),
+        # profiler (ISSUE 14, <= 5%), numerics probes (ISSUE 15, <= 5%).
+        import contextlib as _contextlib
+
+        from keystone_tpu.core import numerics as kbnum
+        from keystone_tpu.core import profiler as kbprof
         from keystone_tpu.core import telemetry as ktelemetry
 
         probe_engine = engines["mnist_fft"]
         probe_reqs = x[:256]
-        with ktelemetry.telemetry_disabled():
-            off = kserve.serve_bench(
-                probe_engine, probe_reqs, clients=4, depth=16,
+
+        def overhead_pass(reqs):
+            return kserve.serve_bench(
+                probe_engine, reqs, clients=4, depth=16,
                 unbatched_baseline=False,
             )
-        on = kserve.serve_bench(
-            probe_engine, probe_reqs, clients=4, depth=16,
-            unbatched_baseline=False,
-        )
-        out["telemetry_overhead"] = {
-            "requests": int(probe_reqs.shape[0]),
-            "p99_off_ms": off["p99_latency_ms"],
-            "p99_on_ms": on["p99_latency_ms"],
-            "qps_off": off["qps"],
-            "qps_on": on["qps"],
-            "p99_overhead_frac": round(
-                on["p99_latency_ms"] / max(off["p99_latency_ms"], 1e-9) - 1.0,
-                4,
-            ),
-            "target_frac": 0.02,
-        }
 
-        # -- profiler overhead (ISSUE 14 acceptance: <= 5% p99) ---------------
-        # The SAME warm engine, same request set: once with the device
-        # cost-attribution layer off (the default), once with the ledger
-        # + watermark sampler on — the p99 ratio IS the cost of profiling
-        # a live endpoint.
-        from keystone_tpu.core import profiler as kbprof
+        def overhead_probe(off_ctx=None, on_ctx=None, warm_on=False,
+                           capture=None):
+            """(off record, on record, captured extras): the off pass runs
+            under ``off_ctx`` (the telemetry tier is on by DEFAULT, so its
+            control arm is the suppressed one), the on pass under
+            ``on_ctx`` — preceded, when ``warm_on``, by one small warmup
+            pass so first-use setup (cost_analysis, jitted-reducer trace)
+            never charges the steady-state bound."""
+            with (off_ctx or _contextlib.nullcontext()):
+                off = overhead_pass(probe_reqs)
+            with (on_ctx or _contextlib.nullcontext()):
+                if warm_on:
+                    overhead_pass(probe_reqs[:64])
+                on = overhead_pass(probe_reqs)
+                captured = capture() if capture is not None else {}
+            return off, on, captured
+
+        def overhead_rows(off, on, frac_key, target):
+            return {
+                "requests": int(probe_reqs.shape[0]),
+                "p99_off_ms": off["p99_latency_ms"],
+                "p99_on_ms": on["p99_latency_ms"],
+                "qps_off": off["qps"],
+                "qps_on": on["qps"],
+                frac_key: round(
+                    on["p99_latency_ms"]
+                    / max(off["p99_latency_ms"], 1e-9)
+                    - 1.0,
+                    4,
+                ),
+                "target_frac": target,
+            }
+
+        off, on, _ = overhead_probe(off_ctx=ktelemetry.telemetry_disabled())
+        out["telemetry_overhead"] = overhead_rows(
+            off, on, "p99_overhead_frac", 0.02
+        )
 
         kbprof.reset_state()
-        prof_off = kserve.serve_bench(
-            probe_engine, probe_reqs, clients=4, depth=16,
-            unbatched_baseline=False,
-        )
-        with kbprof.profiled(True):
-            # One profiled warmup pass first: the first attribution of
-            # each bucket pays its one-time cost_analysis on the executor
-            # thread (cached per executable afterwards) — the bound below
-            # is on the STEADY-STATE overhead a live endpoint pays.
-            kserve.serve_bench(
-                probe_engine, probe_reqs[:64], clients=4, depth=16,
-                unbatched_baseline=False,
-            )
-            prof_on = kserve.serve_bench(
-                probe_engine, probe_reqs, clients=4, depth=16,
-                unbatched_baseline=False,
-            )
-            prof_ledger = {
+        off, on, prof_ledger = overhead_probe(
+            on_ctx=kbprof.profiled(True), warm_on=True,
+            capture=lambda: {
                 label: row
                 for label, row in kbprof.ledger().items()
                 if label.startswith("serve:")
-            }
+            },
+        )
         out["profiler_overhead"] = {
-            "requests": int(probe_reqs.shape[0]),
-            "p99_off_ms": prof_off["p99_latency_ms"],
-            "p99_on_ms": prof_on["p99_latency_ms"],
-            "qps_off": prof_off["qps"],
-            "qps_on": prof_on["qps"],
-            "p99_overhead_frac": round(
-                prof_on["p99_latency_ms"]
-                / max(prof_off["p99_latency_ms"], 1e-9)
-                - 1.0,
-                4,
-            ),
-            "target_frac": 0.05,
-            "bit_identical_on": prof_on["predictions_bit_identical"],
+            **overhead_rows(off, on, "p99_overhead_frac", 0.05),
+            "bit_identical_on": on["predictions_bit_identical"],
             # The per-bucket MFU rows the profiled pass produced — the
             # serve half of the bench "profiler" section's ledger.
             "ledger": prof_ledger,
+        }
+
+        kbnum.reset_state()
+        off, on, num_sites = overhead_probe(
+            on_ctx=kbnum.monitored(True), warm_on=True,
+            capture=lambda: {
+                site: row
+                for site, row in kbnum.site_stats().items()
+                if site.startswith("serve.")
+            },
+        )
+        kbnum.reset_state()
+        out["numerics_overhead"] = {
+            **overhead_rows(off, on, "probe_overhead_frac", 0.05),
+            # Probes must be bit-inert online too: the monitored pass's
+            # answers stay bit-equal to the offline oracle.
+            "bit_identical_on": on["predictions_bit_identical"],
+            "output_drift": on.get("output_drift"),
+            "sites": num_sites,
         }
 
         # -- the wire front-end (ISSUE 12) --------------------------------
@@ -2207,6 +2232,52 @@ def bench_profiler(rng):
     }
 
 
+def bench_numerics(rng, serving: dict | None = None):
+    """Numerics observatory (ISSUE 15): a laddered BCD fit runs MONITORED
+    — the per-block κ table lands in ``FitReport.conditioning`` (the
+    ACCURACY.md §6 sweep live, with the predictive ``cond_warn`` armed) —
+    and the serving probe-overhead measurement from ``bench_serving``
+    (same warm engine, observatory off vs on, <= 5% p99 acceptance) is
+    folded in as the section's headline rows: ``probe_overhead`` and the
+    probed-serve p99 are what ``tools/bench_diff.py`` regresses on across
+    rounds."""
+    from keystone_tpu.core import numerics as knum
+    from keystone_tpu.core.resilience import counters as _counters
+
+    knum.reset_state()
+    n, d, k = 4096, 1024, 16
+    x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    y = one_hot_pm1(rng, n, k)
+    with knum.monitored(True):
+        est = BlockLeastSquaresEstimator(d // 2, 1, 1e-2)
+        est.fit(x, y)
+        cond = (
+            list(est.last_fit_report.conditioning or [])
+            if est.last_fit_report is not None
+            else []
+        )
+    knum.reset_state()
+    probe = (serving or {}).get("numerics_overhead")
+    out = {
+        "conditioning": cond,
+        # kappa=None rows (non-finite gram / estimator failure) are a
+        # documented shape — filter them or max() dies on float vs None.
+        "kappa_max": max(
+            (r["kappa"] for r in cond if r.get("kappa") is not None),
+            default=None,
+        ),
+        "cond_warns": _counters.get("cond_warn"),
+        # The serving-path probe overhead (measured in bench_serving on
+        # the warm mnist_fft engine) — the bench_diff thresholds read
+        # THESE two rows.
+        "probe_overhead": probe,
+        "probed_serve_p99_ms": (
+            probe.get("p99_on_ms") if isinstance(probe, dict) else None
+        ),
+    }
+    return out
+
+
 def bench_self_diff(record: dict, dirpath: str | None = None) -> dict:
     """Regression observatory (ISSUE 11): compare THIS round's record
     against the newest USABLE prior ``BENCH_r*.json`` (a truncated newest
@@ -2273,6 +2344,7 @@ def main():
     serving = _guarded(bench_serving, rng)
     placement = _guarded(bench_placement, rng)
     profiler_sec = _guarded(bench_profiler, rng)
+    numerics_sec = _guarded(lambda r: bench_numerics(r, serving), rng)
     at_scale = _guarded(bench_solve_at_scale, rng)
 
     # ONE atomic registry snapshot feeds both the back-compat "faults" key
@@ -2371,6 +2443,11 @@ def main():
             # plan-drift row count — the section BENCH_r06 reads for the
             # first hardware MFU/drift numbers.
             "profiler": profiler_sec,
+            # Numerics observatory (core.numerics, ISSUE 15): a monitored
+            # BCD fit's per-block κ table (the live ACCURACY.md §6 sweep)
+            # plus the serving probe-overhead rows (<= 5% p99 acceptance)
+            # bench_diff regresses on.
+            "numerics": numerics_sec,
         },
     }
     # Regression observatory (ISSUE 11): this round judged against the
@@ -2495,6 +2572,15 @@ def main():
                     f"{r['bit_identical_on']})"
                 )
                 continue
+            if wk == "numerics_overhead":
+                print(
+                    f"# serving numerics overhead: p99 {r['p99_off_ms']}ms "
+                    f"off -> {r['p99_on_ms']}ms probed "
+                    f"({r['probe_overhead_frac']:+.2%}, target <= "
+                    f"{r['target_frac']:.0%}, bit_identical "
+                    f"{r['bit_identical_on']})"
+                )
+                continue
             if wk == "wire":
                 rt = r["router"]["stats"]
                 print(
@@ -2517,6 +2603,20 @@ def main():
                 f"{r['cold_start']['cold_start_seconds']}s, bit_identical "
                 f"{r['predictions_bit_identical']}"
             )
+    numx = ex["numerics"]
+    if "error" in numx:
+        print(f"# numerics: {numx['error'][:120]}")
+    else:
+        po = numx.get("probe_overhead") or {}
+        kmax = numx.get("kappa_max")
+        print(
+            f"# numerics: kappa_max "
+            f"{f'{kmax:.3g}' if kmax is not None else 'n/a'} over "
+            f"{len(numx['conditioning'])} block(s) "
+            f"({numx['cond_warns']} cond_warn), probed-serve p99 "
+            f"{numx.get('probed_serve_p99_ms')}ms "
+            f"({po.get('probe_overhead_frac', 0.0):+.2%} vs unprobed)"
+        )
     prof = ex["profiler"]
     if "error" in prof:
         print(f"# profiler: {prof['error'][:120]}")

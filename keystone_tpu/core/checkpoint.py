@@ -316,10 +316,16 @@ def _decode(spec: dict, arrays, array_specs: dict, where: str) -> Any:
     raise CheckpointError(f"{where}: unknown manifest entry type {t!r}")
 
 
-def save_pipeline(path: str, pipe) -> str:
+def save_pipeline(path: str, pipe, numerics_baseline: dict | None = None) -> str:
     """Serialize a fitted node / ``Pipeline`` / container of them to
     ``<stem>.npz`` (array leaves) + ``<stem>.json`` (treedef manifest).
     Returns the stem.  Atomic: a crash mid-save leaves no partial artifact.
+
+    ``numerics_baseline``: an optional fit-time output-distribution sketch
+    (``core.numerics.OutputSketch.record()``) persisted in the manifest —
+    the reference the serving tier's output-drift monitor judges live
+    answers against (``serve.load_engine`` arms it on warm load).  Pure
+    metadata: it never affects what the pipeline computes.
     """
     npz_path, manifest_path = checkpoint_paths(path)
     enc = _Encoder()
@@ -346,6 +352,8 @@ def save_pipeline(path: str, pipe) -> str:
         "root": root,
         "arrays": enc.specs,
     }
+    if numerics_baseline is not None:
+        manifest["numerics_baseline"] = numerics_baseline
     _atomic_write_bytes(npz_path, npz_bytes)
     _atomic_write_bytes(
         manifest_path, json.dumps(manifest, indent=1).encode("utf-8")
@@ -443,6 +451,26 @@ def load_pipeline(path: str):
     obj = _decode(manifest["root"], arrays, manifest["arrays"], "root")
     _logger.info("loaded checkpoint %s (%d arrays)", npz_path, len(arrays))
     return obj
+
+
+def load_numerics_baseline(path: str) -> dict | None:
+    """The fit-time output-distribution sketch persisted by
+    ``save_pipeline(numerics_baseline=...)``, or None (absent entry,
+    pre-observatory artifact, unreadable manifest).  Advisory metadata for
+    the drift monitor — this NEVER raises: a missing baseline means an
+    unmonitored engine, not a failed load (``load_pipeline`` holds the
+    manifest to the strict bar)."""
+    _, manifest_path = checkpoint_paths(path)
+    try:
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        _logger.warning(
+            "numerics baseline unreadable from %s (%s)", manifest_path, e
+        )
+        return None
+    baseline = manifest.get("numerics_baseline")
+    return dict(baseline) if isinstance(baseline, dict) else None
 
 
 def load_or_fit(path: str | None, est, *fit_args, save: bool = True, **fit_kwargs):

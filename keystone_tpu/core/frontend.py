@@ -65,6 +65,7 @@ from typing import Callable
 import numpy as np
 
 from . import memory as kmem
+from . import numerics as knum
 from . import telemetry
 from . import trace
 from .resilience import counters
@@ -263,6 +264,13 @@ class ShapeRouter:
         self._closed = False
         self._adapting = False
         self._last_adapt = self._clock()
+        # The router's live state is a /statusz section (ISSUE 15): one
+        # GET on the metrics port shows the engine table, per-engine drift
+        # verdicts, and the admission ledger.  Unregistered at close(),
+        # identity-guarded: a newer same-label router replaces this entry,
+        # and this router's close must then NOT evict the newer one.
+        self._statusz_provider = self.record
+        telemetry.register_statusz(f"router:{label}", self._statusz_provider)
 
     # -- engine lifecycle -----------------------------------------------------
 
@@ -629,6 +637,10 @@ class ShapeRouter:
         entry.server.close()
         entry.server.join()
         telemetry.unregister_slo(entry.engine.label)
+        # A retired engine's drift monitor must leave the live numerics
+        # surface with it (its history belongs to the records that
+        # captured it, not to every future /statusz snapshot).
+        knum.unregister_drift(entry.engine.label)
         with self._lock:
             self.stats.retires += 1
             n = len(self._engines)
@@ -670,6 +682,10 @@ class ShapeRouter:
             entry.server.close()
             entry.server.join()
             telemetry.unregister_slo(entry.engine.label)
+            knum.unregister_drift(entry.engine.label)
+        telemetry.unregister_statusz(
+            f"router:{self.label}", self._statusz_provider
+        )
         trace.metrics.gauge("router_engines", 0)
 
     def __enter__(self) -> "ShapeRouter":
@@ -690,6 +706,14 @@ class ShapeRouter:
                     "live_buckets": list(e.engine.buckets()),
                     "routes": e.routes,
                     "idle_seconds": round(now - e.last_routed, 3),
+                    # Output-drift verdict (ISSUE 15): the engine's live
+                    # divergence vs its fit-time baseline, None when no
+                    # baseline was armed.
+                    "drift": (
+                        e.engine.drift.record()
+                        if e.engine.drift is not None
+                        else None
+                    ),
                 }
                 for k, e in self._engines.items()
             }

@@ -108,6 +108,7 @@ from typing import Callable
 import numpy as np
 
 from ..loaders import image_loaders
+from . import numerics as knum
 from . import snapshot as ksnap
 from . import trace
 from .resilience import counters
@@ -126,6 +127,9 @@ def _device_put(host):
     return jax.device_put(host)
 
 _logger = logging.getLogger("keystone_tpu.ingest")
+
+#: Process-unique sequence for /statusz stream-provider names.
+_stream_seq = itertools.count()
 
 #: Assembled chunks the host ring holds before the producer blocks.  Each
 #: slot is a decoded f32 batch (batch_size * H * W * 3 * 4 bytes), so the
@@ -814,19 +818,35 @@ class StreamBatch:
             from . import profiler as kprof
 
             if not kprof.enabled():
-                return transform(self.dev())
+                return self._probed(transform(self.dev()))
             # Per-program MFU attribution of the featurize dispatch
             # (ISSUE 14).  Values unchanged; pipelining traded for
             # measurement only while the profiler is ON.
             dev = self.dev()
-            return kprof.attributed_call(
+            return self._probed(kprof.attributed_call(
                 f"featurize:{self.shape[0]}x{self.shape[1]}",
                 tuple(np.shape(dev)), transform, dev,
-            )
+            ))
         from ..ops import jpeg_device as jdev
 
         coeffs, qt = self.coeff.arrays()
-        return jdev.fused_apply(transform, self.coeff.geom, coeffs, qt)
+        return self._probed(
+            jdev.fused_apply(transform, self.coeff.geom, coeffs, qt)
+        )
+
+    def _probed(self, out):
+        """Numerics observatory hook (KEYSTONE_NUMERICS=1): the featurize
+        output of every streamed chunk is a tensor-stat probe site, with
+        this chunk's tar member ``names`` as the NaN-provenance map — a
+        non-finite featurize row is counted naming the member that
+        produced it, not just the chunk that carried it.  One flag check
+        when off; the value passes through bit-unchanged either way."""
+        if knum.active():
+            knum.probe(
+                f"stream.featurize.{self.shape[0]}x{self.shape[1]}",
+                out, names=self.names,
+            )
+        return out
 
 
 def _decode_coeffs(chunk: CoeffChunk):
@@ -1032,6 +1052,29 @@ class IngestStream:
             config.ring_capacity,
             transfer,
             bool(self.tuner),
+        )
+        # Live ring/stream state on the /statusz debug page (ISSUE 15) —
+        # jax-free: telemetry is already on the resilience import path.
+        # The name carries a process-unique sequence so two concurrent
+        # streams over the SAME tar each get their own row (and the
+        # identity-guarded unregister means an old stream's close can
+        # never evict a newer one's entry).
+        from . import telemetry as _telemetry
+
+        self._statusz_name = (
+            f"stream:{os.path.basename(path)}#{next(_stream_seq)}"
+        )
+        self._statusz_provider = lambda: {
+            "path": path,
+            "batch_size": batch_size,
+            "decode_threads": self.config.decode_threads,
+            "decode_ahead": self.config.decode_ahead,
+            "ring_capacity": self.config.ring_capacity,
+            "decode_backend": self.config.decode_backend,
+            **self.stats.record(),
+        }
+        _telemetry.register_statusz(
+            self._statusz_name, self._statusz_provider
         )
         self._iter = self._drain()
         self._thread = threading.Thread(
@@ -1664,6 +1707,11 @@ class IngestStream:
         """Stop the producer and release the ring.  Idempotent; called
         automatically on stream exhaustion, consumer exception, or context
         exit."""
+        from . import telemetry as _telemetry
+
+        _telemetry.unregister_statusz(
+            self._statusz_name, self._statusz_provider
+        )
         self._ring.stop()
         # Close the drain generator too: a consumer that stopped early
         # leaves it SUSPENDED at the yield inside an open ingest.consume

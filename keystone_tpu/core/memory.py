@@ -763,6 +763,11 @@ class FitReport:
     #: with deny/score rationale and the chosen plan's predicted-vs-actual
     #: cost.  ``None`` when the fit walked the hand ladder.
     placement: dict | None = None
+    #: numerics observatory (core.numerics, KEYSTONE_NUMERICS=1): per-block
+    #: κ estimates of this solve's gram blocks — the ACCURACY.md §6 offline
+    #: sweep as a live per-fit monitor.  ``None`` when the observatory was
+    #: off for the fit.
+    conditioning: list | None = None
 
     def record(self) -> dict:
         """JSON-able form for bench artifacts."""
@@ -770,6 +775,9 @@ class FitReport:
 
         return {
             "chosen_tier": self.chosen,
+            "conditioning": (
+                list(self.conditioning) if self.conditioning else None
+            ),
             "mesh_shape": dict(self.mesh_shape) if self.mesh_shape else None,
             "budget_gb": (
                 round(self.budget_bytes / 2**30, 3) if self.budget_bytes else None

@@ -37,6 +37,7 @@ from typing import Any, Callable, Generic, Sequence, TypeVar
 
 import jax
 
+from . import numerics
 from . import trace
 
 A = TypeVar("A")
@@ -306,6 +307,12 @@ class Pipeline(Transformer):
 
     def __call__(self, batch):
         counts = getattr(_reuse_tls, "counts", None)
+        # Numerics observatory (KEYSTONE_NUMERICS=1): every node boundary
+        # is a tensor-stat probe site.  One flag check when off; under jit
+        # tracing the probes are inert (XLA owns the values there) — only
+        # the eager apply path is observed, which is also the path every
+        # bit-parity oracle runs.
+        probing = numerics.active() and not isinstance(batch, jax.core.Tracer)
         cachers = self._memo_cachers
         start = 0
         key = None
@@ -327,6 +334,8 @@ class Pipeline(Transformer):
             if counts is not None:
                 _record_exec(n, counts)
             batch = n(batch)
+            if probing:
+                numerics.probe(f"pipeline.{_node_label(n)}", batch)
             if key is not None and i in cachers:
                 n._memo_store(key, batch)
         return batch
@@ -360,6 +369,12 @@ class Pipeline(Transformer):
                     if sync:
                         batch = jax.block_until_ready(batch)
                     dt = time.perf_counter() - t0
+                    if numerics.active():
+                        # The profile pass doubles as a numerics pass: the
+                        # same per-node boundaries, under `profile.` sites
+                        # so a profiled batch's stats are separable from
+                        # live traffic's.
+                        numerics.probe(f"profile.{label}", batch)
                     nbytes, dtype, shape, leaves = _output_stats(batch)
                     sp.set(
                         seconds=round(dt, 6),

@@ -269,13 +269,24 @@ def assert_all_finite(tree, name: str = "fitted model"):
         if not finite:
             bad.append(i)
     if bad:
+        # NaN provenance (core.numerics, ISSUE 15): when a probe already
+        # bisected a non-finite streamed/served batch to its tar members /
+        # request ids, the typed error names the culprit instead of just
+        # the model that absorbed it.  Function-local import (numerics is
+        # jax-free, but this module must not grow import weight).
+        from . import numerics
+
+        note = numerics.provenance_note()
+        suffix = f"; {note}" if note else ""
         counters.record(
-            "nonfinite_model", f"{name}: {len(bad)} non-finite leaf/leaves"
+            "nonfinite_model",
+            f"{name}: {len(bad)} non-finite leaf/leaves{suffix}",
         )
         raise FloatingPointError(
             f"{name} contains non-finite values in {len(bad)} leaf/leaves "
             f"(indices {bad}) — refusing to ship a silently-broken model "
             "(ill-conditioned solve, NaN input batch, or overflow upstream)"
+            + suffix
         )
     return tree
 

@@ -91,6 +91,7 @@ from typing import Any, Callable, Sequence
 import numpy as np
 
 from . import memory as kmem
+from . import numerics as knum
 from . import profiler as kprof
 from . import telemetry
 from . import trace
@@ -285,6 +286,10 @@ class ServingEngine:
         self.parity_ok: bool = True
         self._exec: dict[int, Any] = {}
         self._lock = threading.Lock()
+        #: numerics observatory (ISSUE 15): the output-drift monitor, armed
+        #: by :meth:`arm_drift_baseline` when a fit-time reference baseline
+        #: exists (load_engine reads it from the checkpoint manifest).
+        self.drift: knum.DriftMonitor | None = None
         self._build()
         if warmup:
             self.warmup()
@@ -483,6 +488,27 @@ class ServingEngine:
             remaining=remaining,
         )
 
+    def arm_drift_baseline(self, baseline: dict | None) -> None:
+        """Arm output-drift detection against a fit-time reference sketch
+        (the ``numerics_baseline`` entry ``core.checkpoint.save_pipeline``
+        persists in the manifest).  None is a no-op — an engine without a
+        baseline serves exactly as before."""
+        if baseline:
+            self.drift = knum.DriftMonitor(self.label, baseline)
+
+    def observe_output(self, host_rows, request_ids=None, bucket=None) -> None:
+        """Numerics observatory hook on one bucket's ANSWERED rows: a
+        tensor-stat probe (request ids as the NaN-provenance map) plus the
+        output-drift sketch.  Observation only — the rows are already on
+        their way to the callers, bit-unchanged.  One flag check when the
+        observatory is off."""
+        if not knum.active():
+            return
+        site = f"serve.{self.label}" + (f".b{bucket}" if bucket else "")
+        knum.probe(site, host_rows, request_ids=request_ids)
+        if self.drift is not None:
+            self.drift.observe(host_rows)
+
     def _pad(self, host: np.ndarray, bucket: int) -> np.ndarray:
         pad = bucket - host.shape[0]
         if pad <= 0:
@@ -555,6 +581,7 @@ class ServingEngine:
             int(getattr(out, "nbytes", 0)), cat="serve", bucket=bucket,
         ):
             host = np.asarray(out)
+        self.observe_output(host[:k], bucket=bucket)
         return host[:k]
 
     def offline(self, host_batch: np.ndarray) -> np.ndarray:
@@ -581,6 +608,9 @@ class ServingEngine:
             "memory_plans": {
                 str(k): p.breakdown() for k, p in self.memory_plans.items()
             },
+            # Output-drift verdict (ISSUE 15): None when no fit-time
+            # baseline was armed.
+            "drift": self.drift.record() if self.drift is not None else None,
         }
 
 
@@ -599,7 +629,7 @@ def load_engine(
     servable Transformer (e.g. a workload assembling a checkpointed dict
     of fitted nodes into its apply chain).  Returns
     ``(engine, cold_start_record)``."""
-    from .checkpoint import load_pipeline
+    from .checkpoint import load_numerics_baseline, load_pipeline
 
     t0 = time.perf_counter()
     with trace.span("serve.cold_load", cat="serve", path=path):
@@ -610,6 +640,10 @@ def load_engine(
     engine = ServingEngine(
         pipe, example, config=config, label=label, warmup=False
     )
+    # Output-drift detection (ISSUE 15): arm the monitor from the
+    # fit-time reference sketch the checkpoint manifest carries (absent
+    # on pre-observatory artifacts — the engine just serves unmonitored).
+    engine.arm_drift_baseline(load_numerics_baseline(path))
     t_build = time.perf_counter()
     engine.warmup()
     t_warm = time.perf_counter()
@@ -1019,6 +1053,16 @@ class Server:
             )
             self._fail_futs(futs, e)
             return
+        if not degraded:
+            # Numerics observatory: probe + drift-sketch this bucket's
+            # answered rows with their request ids as provenance.  The
+            # degraded path already observed through infer()'s own chunks
+            # — observing again would double-count the sketch.
+            self.engine.observe_output(
+                host[:n],
+                request_ids=[f.request_id for f in futs],
+                bucket=bucket,
+            )
         self.stats.batches += 1
         self.stats.answered += n
         self.stats.occupancy_sum += n / bucket
@@ -1267,6 +1311,11 @@ def serve_bench(
         "slo": slo,
         "predictions_bit_identical": bool(np.array_equal(answers, offline)),
     }
+    if engine.drift is not None:
+        # Output-drift verdict over the benched traffic (ISSUE 15) —
+        # per-engine divergence vs the fit-time baseline, the row
+        # tools/health_view.py renders.
+        record["output_drift"] = engine.drift.record()
     if aot_oracle is not None:
         record["parity_unverified"] = True
         record["predictions_deterministic"] = bool(

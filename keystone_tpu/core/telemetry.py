@@ -82,6 +82,14 @@ POSTMORTEM_KINDS = frozenset(
         "serve_bucket_parity_dropped",
         "snapshot_fallback",
         "nonfinite_model",
+        # Numerics observatory (ISSUE 15): a probe catching non-finite
+        # values in a streamed/served batch, and a serving engine's output
+        # distribution diverging from its fit-time baseline — both carry
+        # their provenance/divergence evidence in the dumped metrics
+        # snapshot's "numerics" group (and maybe_postmortem's capture hook
+        # opens the bounded xprof window the ISSUE asks for).
+        "numerics_nonfinite",
+        "serve_output_drift",
     }
 )
 
@@ -328,6 +336,67 @@ def telemetry_disabled():
         _suspended = prev_susp
 
 
+# -- the /statusz debug surface ------------------------------------------------
+
+_statusz_lock = threading.Lock()
+_statusz_providers: dict[str, object] = {}
+
+
+def register_statusz(name: str, provider) -> None:
+    """Register a live-state provider (a zero-arg callable returning a
+    JSON-able dict) under ``name`` on the ``/statusz`` debug page —
+    routers register their engine tables, streams their ring state.  A
+    new registration under the same name replaces the old (the page shows
+    the CURRENT object, not a dead one's history)."""
+    with _statusz_lock:
+        _statusz_providers[name] = provider
+
+
+def unregister_statusz(name: str, provider=None) -> None:
+    """Drop ``name``'s provider.  Pass the registered ``provider`` back to
+    make the removal identity-guarded: if a NEWER object has since
+    registered under the same name, the old owner's unregister is a no-op
+    instead of evicting the live provider."""
+    with _statusz_lock:
+        if provider is None or _statusz_providers.get(name) is provider:
+            _statusz_providers.pop(name, None)
+
+
+def statusz_snapshot() -> dict:
+    """One JSON snapshot of the process's live operational state: every
+    registered provider (router engine tables, ring/stream state), the
+    rolling SLO windows, the numerics observatory surface, and the
+    metrics registry (fault ledger included).  Served at ``/statusz`` on
+    the ``KEYSTONE_METRICS_PORT`` endpoint; also directly callable (the
+    golden tests pin the schema).  A provider that raises is reported as
+    its error string — one sick subsystem must not blank the page."""
+    providers: dict = {}
+    with _statusz_lock:
+        items = list(_statusz_providers.items())
+    for name, provider in items:
+        try:
+            providers[name] = provider()
+        except Exception as e:  # noqa: BLE001 — the page must render
+            providers[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    # Importing numerics (jax-free) ensures its adopted metrics group
+    # exists, so ONE registry snapshot carries the whole surface — no
+    # second numerics.snapshot() pass per GET.
+    from . import numerics
+
+    snap = trace.metrics.snapshot()
+    return {
+        "schema": "keystone.statusz/1",
+        "time_unix": time.time(),
+        "pid": os.getpid(),
+        "providers": providers,
+        "slo": snap.get("slo", {}),
+        "numerics": snap.get("numerics") or numerics.snapshot(),
+        "faults": snap.get("faults", {}),
+        "counters": snap.get("counters", {}),
+        "gauges": snap.get("gauges", {}),
+    }
+
+
 # -- Prometheus text exposition -----------------------------------------------
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
@@ -440,20 +509,34 @@ class MetricsWriter:
 
 
 def start_metrics_server(port: int):
-    """Tiny in-process HTTP endpoint serving :func:`prometheus_text` at
-    ``/metrics`` (and ``/``) on 127.0.0.1.  ``port=0`` binds an ephemeral
-    port (``server.server_address[1]``).  Returns the live
-    ``ThreadingHTTPServer`` — call ``.shutdown()`` to stop."""
+    """Tiny in-process HTTP endpoint on 127.0.0.1: :func:`prometheus_text`
+    at ``/metrics`` (and ``/``), the :func:`statusz_snapshot` JSON debug
+    page at ``/statusz``, and a ``/healthz`` liveness probe.  ``port=0``
+    binds an ephemeral port (``server.server_address[1]``).  Returns the
+    live ``ThreadingHTTPServer`` — call ``.shutdown()`` to stop."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 — http.server API
-            if self.path.split("?")[0] not in ("/", "/metrics"):
+            route = self.path.split("?")[0]
+            if route == "/healthz":
+                body = b'{"ok": true}\n'
+                ctype = "application/json"
+            elif route == "/statusz":
+                try:
+                    body = json.dumps(statusz_snapshot()).encode()
+                except Exception as e:  # noqa: BLE001 — a debug page
+                    self.send_error(500, f"{type(e).__name__}: {e}"[:200])
+                    return
+                ctype = "application/json"
+            elif route in ("/", "/metrics"):
+                body = prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4"
+            else:
                 self.send_error(404)
                 return
-            body = prometheus_text().encode()
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -546,12 +629,15 @@ def maybe_postmortem(kind: str, detail: str | None = None, total: int = 0):
 
 
 def _reset_state() -> None:
-    """Test isolation: forget dump caps/paths and SLO trackers."""
+    """Test isolation: forget dump caps/paths, SLO trackers, and statusz
+    providers."""
     with _pm_lock:
         _pm_counts.clear()
         _pm_paths.clear()
     with _slo_lock:
         _slo_trackers.clear()
+    with _statusz_lock:
+        _statusz_providers.clear()
 
 
 # -- env activation -----------------------------------------------------------

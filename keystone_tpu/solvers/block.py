@@ -23,6 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import autoshard
 from ..core import memory as kmem
+from ..core import numerics as knum
 from ..core import profiler as kprof
 from ..core import trace
 from ..core.checkpoint import CheckpointError, _atomic_write_bytes
@@ -823,7 +824,36 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         x, widths = _blocked_design_matrix(
             features, self.block_size, num_features
         )
+        # Conditioning monitor (ISSUE 15): per-block κ estimates riding
+        # the blocked design matrix this fit already formed (row-capped,
+        # so the probe never re-uploads a host-staged matrix).  One flag
+        # check when the observatory is off.
+        cond_rows = (
+            knum.design_conditioning(
+                x, widths, float(self.lam), label="bcd_fit"
+            )
+            if knum.active()
+            else None
+        )
+        # Any per-solve κ estimate emitted DURING the fit (the
+        # _guarded_solve hook in normal_equations) joins the design-block
+        # probes in the report.
+        cond_ctx = knum.collect_conditioning()
+        solve_cond = cond_ctx.__enter__()
+        try:
+            return self._fit_dispatch(
+                features, x, labels, num_features, nvalid, widths,
+                checkpoint, resume_from, donate, plan, mesh, resumable,
+                cond_rows, solve_cond,
+            )
+        finally:
+            cond_ctx.__exit__(None, None, None)
 
+    def _fit_dispatch(
+        self, features, x, labels, num_features, nvalid, widths,
+        checkpoint, resume_from, donate, plan, mesh, resumable,
+        cond_rows, solve_cond,
+    ):
         if resumable:
             if nvalid is None:
                 nvalid = int(jnp.shape(labels)[0])
@@ -870,6 +900,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 features, x, labels, num_features, nvalid, widths, donate,
                 plan_arg=plan,
             )
+        all_cond = (cond_rows or []) + list(solve_cond)
+        if all_cond and self.last_fit_report is not None:
+            self.last_fit_report.conditioning = all_cond
         model_list = [models[i, :w] for i, w in enumerate(widths)]
         feature_scalers = [
             StandardScalerModel(means[i, :w]) for i, w in enumerate(widths)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import numerics as knum
 from ..core.resilience import numerics_guard_enabled
 from ..parallel.collectives import sharded_gram
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, padded_shard_rows
@@ -62,6 +63,13 @@ def _guarded_solve(solve_fn, ata, atb, lam):
     restores the unguarded single-dispatch path.
     """
     lam_arr = jnp.asarray(lam, ata.dtype)
+    if knum.active():
+        # Conditioning monitor (ISSUE 15): a few-step power-iteration κ
+        # estimate on the very gram this Cholesky is about to factor,
+        # recorded into the active fit's FitReport.conditioning and
+        # counted as a predictive ``cond_warn`` BEFORE the jitter-retry
+        # ladder below ever trips — the ACCURACY.md §6 sweep live.
+        knum.estimate_gram_condition(ata, float(lam), label="solve_gram_l2")
     if not numerics_guard_enabled():
         return solve_fn(ata, atb, lam_arr)
     if not _all_finite(ata) or not _all_finite(atb):

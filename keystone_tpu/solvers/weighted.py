@@ -47,6 +47,7 @@ import dataclasses
 
 from ..core import autoshard
 from ..core import memory as kmem
+from ..core import numerics as knum
 from ..core import profiler as kprof
 from ..core import trace
 from ..core.pipeline import LabelEstimator
@@ -654,6 +655,16 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         x, widths = _blocked_design_matrix(
             features, self.block_size, num_features
         )
+        # Conditioning monitor (ISSUE 15): per-block κ estimates on the
+        # blocked design matrix this fit already formed (row-capped probe;
+        # one flag check when the observatory is off).
+        cond_rows = (
+            knum.design_conditioning(
+                x, widths, float(self.lam), label="bwls_fit"
+            )
+            if knum.active()
+            else None
+        )
         dtype = jnp.asarray(x[:1, :1]).dtype
         w = self.mixture_weight
 
@@ -824,6 +835,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 features, x, labels, prep(None, labels), order, n, n_max,
                 n_classes, widths, dtype, donate, plan_arg=plan,
             )
+        if cond_rows and self.last_fit_report is not None:
+            self.last_fit_report.conditioning = cond_rows
         model_list = [models_st[i, :wd] for i, wd in enumerate(widths)]
         return BlockLinearMapper(model_list, self.block_size, b)
 

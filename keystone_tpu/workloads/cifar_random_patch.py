@@ -590,7 +590,19 @@ def run(
     # (model splits the features by its own fitted block widths).
     servable = Pipeline([*conv_pipe.nodes, scaler, model, MaxClassifier()])
     if conf.pipeline_file is not None:
-        save_pipeline(conf.pipeline_file, servable)
+        from ..core import numerics as knum
+
+        # Fit-time output baseline (ISSUE 15): the predicted-class
+        # distribution rides the checkpoint manifest, so the serving
+        # tier's drift monitor has a reference to judge live answers
+        # against from the moment the engine warm-loads.
+        save_pipeline(
+            conf.pipeline_file,
+            servable,
+            numerics_baseline=knum.OutputSketch.for_outputs(
+                results["test_predictions"]
+            ).record(),
+        )
         log.log_info("saved fitted servable pipeline to %s", conf.pipeline_file)
     _maybe_serve(conf, test, results, log)
     log.log_info("Training error is: %s", train_eval.total_error)

@@ -260,7 +260,19 @@ def run(
         [GroupConcatFeaturizer(batch_featurizer), model, MaxClassifier()]
     )
     if conf.pipeline_file is not None:
-        save_pipeline(conf.pipeline_file, servable)
+        from ..core import numerics as knum
+
+        # Fit-time output baseline (ISSUE 15): the predicted-class
+        # distribution is persisted in the checkpoint manifest — the
+        # reference the serving tier's output-drift monitor judges live
+        # answers against once the engine warm-loads this artifact.
+        save_pipeline(
+            conf.pipeline_file,
+            servable,
+            numerics_baseline=knum.OutputSketch.for_outputs(
+                results["test_predictions"]
+            ).record(),
+        )
         log.log_info("saved fitted servable pipeline to %s", conf.pipeline_file)
     _maybe_serve(conf, test, results, log)
 

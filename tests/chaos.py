@@ -101,6 +101,13 @@ draws its parameters — fully deterministic):
   the profiled run COMPLETES, and its outputs are bit-equal to an
   unprofiled run — observability may die, the workload may not, and a
   dead profiler must never change a single bit of the answer.
+* ``output_drift`` — a deterministically SHIFTED request mix replayed
+  against a served classifier engine whose output-drift monitor
+  (core.numerics, KEYSTONE_NUMERICS) is armed with a fit-time baseline:
+  the divergence must be counted (``serve_output_drift``) with a
+  flight-recorder postmortem dumped, and every answer must stay
+  bit-equal to an UNMONITORED engine serving the same mix — detection
+  fires loudly, the answers never change.
 """
 
 from __future__ import annotations
@@ -163,6 +170,7 @@ FAMILIES = (
     "slow_loris",
     "jpeg_corrupt_entropy",
     "profiler_crash",
+    "output_drift",
 )
 
 #: The serving-path families (core.serve / core.frontend / core.wire),
@@ -173,12 +181,13 @@ SERVE_FAMILIES = (
     "serve_burst_oom",
     "wire_disconnect",
     "slow_loris",
+    "output_drift",
 )
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(21))
-FULL_SEEDS = tuple(range(42))
+TIER1_SEEDS = tuple(range(22))
+FULL_SEEDS = tuple(range(44))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -351,6 +360,17 @@ def make_schedule(seed: int) -> Fault:
             kind,
             {"batch": 4, "crash_after": int(rng.integers(1, 5))},
         )
+    if kind == "output_drift":
+        return Fault(
+            kind,
+            {
+                "reference": int(rng.integers(48, 81)),
+                # Must clear numerics.DRIFT_MIN_COUNT with margin so the
+                # monitor is allowed to judge the shifted mix.
+                "shifted": int(rng.integers(48, 81)),
+                "shift_scale": float(rng.uniform(4.0, 8.0)),
+            },
+        )
     return Fault("deadline", {"seconds": 1.0})
 
 
@@ -518,7 +538,8 @@ def _clean_env():
         k: os.environ.pop(k, None)
         for k in (
             kmem.HBM_BUDGET_ENV, "KEYSTONE_NUMERICS_GUARD",
-            "KEYSTONE_PROFILER",
+            "KEYSTONE_PROFILER", "KEYSTONE_NUMERICS",
+            "KEYSTONE_DRIFT_TOL", "KEYSTONE_POSTMORTEM_DIR",
         )
     }
     try:
@@ -1377,6 +1398,111 @@ def _slow_loris_phase(fault: Fault, tmpdir: str, seed: int) -> None:
     )
 
 
+def _output_drift_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """A deterministically shifted request mix against a served classifier
+    engine whose output-drift monitor (core.numerics) is armed with a
+    fit-time baseline: the divergence must be COUNTED
+    (``serve_output_drift``) with a flight-recorder postmortem dumped, and
+    every answer must stay bit-equal to an UNMONITORED engine serving the
+    same mix — the observatory detects, it never alters an answer."""
+    import glob as _glob
+
+    import jax.numpy as jnp
+
+    from keystone_tpu.core import numerics as knum
+    from keystone_tpu.core import serve as kserve
+    from keystone_tpu.core import telemetry as ktelemetry
+    from keystone_tpu.core.pipeline import FunctionTransformer
+
+    rng = np.random.default_rng(seed)
+    n_ref = int(fault.params["reference"])
+    n_shift = int(fault.params["shifted"])
+    scale = float(fault.params["shift_scale"])
+
+    # A classifier head built from fusion-invariant arithmetic (exactly-
+    # rounded multiply + max, like _serve_engine) so eager == jit == every
+    # bucket and the bit-equality oracle tests the MONITOR, not XLA's
+    # rounding moods.  Weights are schedule-invariant.
+    wrng = np.random.default_rng(_DATA_SEED)
+    w_np = wrng.normal(size=(16,)).astype(np.float32)
+    b_np = wrng.normal(size=(16,)).astype(np.float32)
+    w, b = jnp.asarray(w_np), jnp.asarray(b_np)
+    pipe = FunctionTransformer(
+        lambda x: jnp.argmax(jnp.maximum(x * w, b), axis=-1),
+        name="chaos_drift_head",
+    )
+    cfg = kserve.ServeConfig(buckets=(1, 2, 4), max_wait_ms=2.0)
+    engine = kserve.ServingEngine(
+        pipe, np.zeros(16, np.float32), config=cfg, label="chaos_drift"
+    )
+
+    # The fit-time reference: the engine's own offline answers over an
+    # unshifted request population.
+    ref = _serve_requests(rng, n_ref)
+    baseline = knum.OutputSketch.for_outputs(engine.offline(ref)).record()
+
+    # The deterministic shift: push the feature with the LARGEST positive
+    # weight, so the shifted mix's argmax collapses onto that class and
+    # the answer distribution demonstrably leaves the baseline.
+    shift = np.zeros(16, np.float32)
+    shift[int(np.argmax(w_np))] = scale
+    shifted = _serve_requests(rng, n_shift) + shift
+
+    # The unmonitored oracle: the SAME engine, observatory off.
+    with kserve.Server(engine) as server:
+        unmon = np.stack(
+            [f.result(30.0) for f in [server.submit(r) for r in shifted]]
+        )
+
+    pm_dir = os.path.join(tmpdir, f"chaos_drift_{seed}_pm")
+    # Re-open the per-kind postmortem budget for THIS schedule (earlier
+    # suite activity may have spent the process cap).
+    with ktelemetry._pm_lock:
+        ktelemetry._pm_counts.pop("serve_output_drift", None)
+    before = counters.get("serve_output_drift")
+    os.environ["KEYSTONE_POSTMORTEM_DIR"] = pm_dir
+    try:
+        with knum.monitored(True):
+            engine.arm_drift_baseline(baseline)
+            with kserve.Server(engine) as server:
+                mon = np.stack(
+                    [
+                        f.result(30.0)
+                        for f in [server.submit(r) for r in shifted]
+                    ]
+                )
+            drift_rec = engine.drift.record()
+    finally:
+        os.environ.pop("KEYSTONE_POSTMORTEM_DIR", None)
+        knum.reset_state()
+    if counters.get("serve_output_drift") - before < 1:
+        raise ChaosOracleError(
+            f"shifted request mix (divergence {drift_rec['divergence']}, "
+            f"tol {drift_rec['tol']}) produced no counted "
+            "serve_output_drift — the monitor missed a real distribution "
+            "shift"
+        )
+    dumps = _glob.glob(
+        os.path.join(pm_dir, "postmortem_serve_output_drift_*.json")
+    )
+    if not dumps:
+        raise ChaosOracleError(
+            "serve_output_drift was counted but no flight-recorder "
+            "postmortem was dumped — the drift fired without evidence"
+        )
+    if not np.array_equal(mon, unmon):
+        raise ChaosOracleError(
+            "monitored engine's answers differ from the unmonitored "
+            "engine's — the observatory changed RESULTS, not just what "
+            "is observed"
+        )
+    if not np.array_equal(mon, engine.offline(shifted)):
+        raise ChaosOracleError(
+            "served answers under drift detection differ from the "
+            "offline apply"
+        )
+
+
 def _stepdown_oracle(
     res: dict,
     stepdown_delta: int,
@@ -1458,6 +1584,10 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "profiler_crash":
         _profiler_crash_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "output_drift":
+        _output_drift_phase(fault, tmpdir, seed)
         return _run_workload(workload)
 
     if fault.kind == "stream_hang":

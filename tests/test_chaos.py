@@ -37,6 +37,7 @@ EXPECTED_COUNTER = {
     "slow_loris": "chaos_slow_loris",
     "jpeg_corrupt_entropy": "jpeg_corrupt_entropy",
     "profiler_crash": "profiler_sampler_crash",
+    "output_drift": "serve_output_drift",
 }
 
 
@@ -60,7 +61,7 @@ def test_chaos_schedule_mnist(seed, tmp_path):
     trace_path = str(tmp_path / f"chaos_seed{seed}.json")
     r = chaos.run_schedule(
         seed, "mnist", tmpdir=str(tmp_path), trace_path=trace_path
-    )
+    )  # 22 families as of ISSUE 15 (output_drift)
     _check(r)
     violations = chaos.verify_trace(trace_path, r)
     assert violations == [], {
@@ -115,6 +116,11 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # bit-equal to an unprofiled run — observability may die, the
     # workload may not
     assert "profiler_crash" in kinds
+    # Numerics-observatory coverage (ISSUE 15): a shifted request mix
+    # against a drift-armed served engine must be counted
+    # serve_output_drift with a postmortem, every answer bit-equal to an
+    # unmonitored engine
+    assert "output_drift" in kinds
 
 
 def test_schedules_are_deterministic():

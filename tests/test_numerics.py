@@ -1,0 +1,480 @@
+"""Numerics & model-quality observatory (core.numerics, ISSUE 15).
+
+The contract under test, in order of importance:
+
+1. **Bit-inertness** — enabling the observatory never changes a value on
+   any probed path (pipeline apply/profile, streamed featurize, served
+   answers): same bytes out, monitored or not.
+2. **Zero retained allocation off** — with the observatory disabled every
+   hook is one flag check and NO per-site state accumulates.
+3. **Sampling-rate math** — ``KEYSTONE_NUMERICS_SAMPLE=N`` reduces one
+   probe in N, deterministically (visit 1 always probes).
+4. **Stats / conditioning / provenance / drift correctness** — the
+   reducer against numpy oracles, the κ estimate against
+   ``np.linalg.cond``, the bisect naming the exact poisoned member or
+   request, the drift monitor counting exactly once per breach.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.core import checkpoint as kckpt
+from keystone_tpu.core import numerics as knum
+from keystone_tpu.core import serve as kserve
+from keystone_tpu.core import trace
+from keystone_tpu.core.ingest import StreamBatch
+from keystone_tpu.core.pipeline import FunctionTransformer, Identity, Pipeline
+from keystone_tpu.core.resilience import assert_all_finite, counters
+from keystone_tpu.solvers.block import BlockLeastSquaresEstimator
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+
+@pytest.fixture(autouse=True)
+def _clean_numerics(monkeypatch):
+    monkeypatch.delenv(knum.NUMERICS_ENV, raising=False)
+    monkeypatch.delenv(knum.SAMPLE_ENV, raising=False)
+    monkeypatch.delenv(knum.DRIFT_TOL_ENV, raising=False)
+    knum.reset_state()
+    yield
+    knum.reset_state()
+
+
+# -- the tensor-stat reducer ---------------------------------------------------
+
+
+def test_tensor_stats_match_numpy_oracle(rng):
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    x[3, 2] = 0.0
+    for arr in (x, jnp.asarray(x)):
+        s = knum.tensor_stats(arr)
+        assert s["count"] == x.size
+        assert s["nonfinite"] == 0
+        np.testing.assert_allclose(s["mean"], x.mean(), rtol=1e-5)
+        np.testing.assert_allclose(s["std"], x.std(), rtol=1e-4)
+        np.testing.assert_allclose(s["min"], x.min(), rtol=1e-6)
+        np.testing.assert_allclose(s["max"], x.max(), rtol=1e-6)
+        np.testing.assert_allclose(s["abs_max"], np.abs(x).max(), rtol=1e-6)
+        np.testing.assert_allclose(s["zero_frac"], 1.0 / x.size, rtol=1e-5)
+
+
+def test_tensor_stats_moments_exclude_nonfinite():
+    x = np.array([1.0, np.nan, 3.0, np.inf, 0.0], np.float32)
+    for arr in (x, jnp.asarray(x)):
+        s = knum.tensor_stats(arr)
+        assert s["nonfinite"] == 2
+        np.testing.assert_allclose(s["mean"], (1 + 3 + 0) / 3, rtol=1e-6)
+        assert s["min"] == 0.0 and s["max"] == 3.0
+
+
+def test_tensor_stats_all_nonfinite_reports_zero_extremes():
+    s = knum.tensor_stats(np.full(4, np.nan, np.float32))
+    assert s["nonfinite"] == 4
+    assert s["min"] == 0.0 and s["max"] == 0.0 and s["abs_max"] == 0.0
+
+
+def test_nonfinite_rows_bisects_to_exact_rows():
+    x = np.ones((13, 3), np.float32)
+    x[2, 1] = np.nan
+    x[7, 0] = np.inf
+    x[12, 2] = -np.inf
+    assert knum.nonfinite_rows(x) == [2, 7, 12]
+    assert knum.nonfinite_rows(np.ones((5, 2), np.float32)) == []
+
+
+# -- sampling + disabled-mode discipline ---------------------------------------
+
+
+def test_probe_sampling_rate_math(monkeypatch):
+    monkeypatch.setenv(knum.SAMPLE_ENV, "3")
+    x = np.ones(4, np.float32)
+    with knum.monitored(True):
+        for _ in range(10):
+            knum.probe("sample_site", x)
+    s = knum.site_stats()["sample_site"]
+    # visits 1, 4, 7, 10 probe ((visit-1) % 3 == 0): 4 of 10.
+    assert s["visits"] == 10
+    assert s["sampled"] == 4
+
+
+def test_disabled_mode_retains_no_state_and_returns_same_object(rng):
+    x = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+    assert not knum.active()
+    for _ in range(50):
+        out = knum.probe("off_site", x)
+        assert out is x
+    assert knum.site_stats() == {}
+    assert knum.snapshot()["sites"] == {}
+
+
+def test_probe_returns_same_object_when_enabled(rng):
+    x = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+    with knum.monitored(True):
+        assert knum.probe("on_site", x) is x
+    assert knum.site_stats()["on_site"]["sampled"] == 1
+
+
+# -- bit-inertness on every probed path ----------------------------------------
+
+
+def _toy_pipeline():
+    w = jnp.asarray(np.linspace(0.5, 2.0, 8).astype(np.float32))
+    return Pipeline(
+        [
+            FunctionTransformer(lambda x: x * w, name="scale"),
+            FunctionTransformer(lambda x: jnp.maximum(x, 0.1), name="clip"),
+        ]
+    )
+
+
+def test_pipeline_apply_bit_inert(rng):
+    pipe = _toy_pipeline()
+    x = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    plain = np.asarray(pipe(x))
+    with knum.monitored(True):
+        probed = np.asarray(pipe(x))
+    assert plain.tobytes() == probed.tobytes()
+    sites = knum.site_stats()
+    assert "pipeline.scale" in sites and "pipeline.clip" in sites
+
+
+def test_pipeline_probe_inert_under_jit(rng):
+    pipe = _toy_pipeline()
+    x = jnp.asarray(rng.normal(size=(4, 8)).astype(np.float32))
+    with knum.monitored(True):
+        jitted = np.asarray(jax.jit(pipe.__call__)(x))
+        # Tracing must not have created probe sites (Tracer batches skip).
+        assert all(not s.startswith("pipeline.") for s in knum.site_stats())
+    assert np.array_equal(jitted, np.asarray(pipe(x)))
+
+
+def test_pipeline_profile_bit_inert(rng):
+    pipe = _toy_pipeline()
+    x = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    plain = np.asarray(pipe.profile(x).output)
+    with knum.monitored(True):
+        probed = np.asarray(pipe.profile(x).output)
+    assert plain.tobytes() == probed.tobytes()
+    assert "profile.scale" in knum.site_stats()
+
+
+def test_stream_featurize_bit_inert(rng):
+    host = rng.uniform(0, 1, (6, 4, 4, 3)).astype(np.float32)
+    feat = jax.jit(lambda x: jnp.mean(x, axis=(1, 2, 3)))
+
+    def batch():
+        return StreamBatch(
+            index=0,
+            indices=np.arange(6),
+            names=[f"img{i}.jpg" for i in range(6)],
+            host=host.copy(),
+        )
+
+    plain = np.asarray(batch().apply(feat))
+    with knum.monitored(True):
+        probed = np.asarray(batch().apply(feat))
+    assert plain.tobytes() == probed.tobytes()
+    assert any(s.startswith("stream.featurize.") for s in knum.site_stats())
+
+
+def _serve_engine(pipe_fn=None, label="numtest", buckets=(1, 2, 4)):
+    w = jnp.asarray(np.linspace(-1.0, 1.0, 8).astype(np.float32))
+    b = jnp.asarray(np.linspace(0.1, 0.4, 8).astype(np.float32))
+    pipe = FunctionTransformer(
+        pipe_fn or (lambda x: jnp.maximum(x * w, b)), name=f"{label}_head"
+    )
+    cfg = kserve.ServeConfig(buckets=buckets, max_wait_ms=2.0)
+    return kserve.ServingEngine(
+        pipe, np.zeros(8, np.float32), config=cfg, label=label
+    )
+
+
+def test_served_answers_bit_inert(rng):
+    engine = _serve_engine(label="inert")
+    reqs = rng.normal(size=(24, 8)).astype(np.float32)
+    with kserve.Server(engine) as server:
+        plain = np.stack(
+            [f.result(30.0) for f in [server.submit(r) for r in reqs]]
+        )
+    with knum.monitored(True):
+        with kserve.Server(engine) as server:
+            probed = np.stack(
+                [f.result(30.0) for f in [server.submit(r) for r in reqs]]
+            )
+    assert plain.tobytes() == probed.tobytes()
+    assert any(s.startswith("serve.inert") for s in knum.site_stats())
+
+
+# -- NaN provenance ------------------------------------------------------------
+
+
+def test_stream_nan_provenance_names_the_member():
+    host = np.ones((5, 2, 2, 1), np.float32)
+    host[3, 0, 0, 0] = np.nan
+    sb = StreamBatch(
+        index=0,
+        indices=np.arange(5),
+        names=[f"n{i:03d}.jpg" for i in range(5)],
+        host=host,
+    )
+    before = counters.get("numerics_nonfinite")
+    with knum.monitored(True):
+        out = sb.apply(lambda x: jnp.mean(x, axis=(1, 2, 3)))
+    assert np.isnan(np.asarray(out)[3])  # value untouched — detection only
+    assert counters.get("numerics_nonfinite") - before == 1
+    note = knum.provenance_note()
+    assert note is not None and "n003.jpg" in note and "member" in note
+    # The typed error the fit guard raises names the member too.
+    with pytest.raises(FloatingPointError, match="n003.jpg"):
+        assert_all_finite(out, "poisoned featurize")
+
+
+def test_serve_nan_provenance_names_the_request(rng):
+    # A head that poisons its output whenever feature 0 exceeds 2.5 —
+    # submit-side validation passes (inputs are finite), the OUTPUT NaNs.
+    def head(x):
+        return jnp.where(x[..., :1] > 2.5, jnp.nan, 1.0) * x
+
+    engine = _serve_engine(pipe_fn=head, label="nanserve")
+    good = rng.normal(size=(6, 8)).astype(np.float32).clip(-2, 2)
+    bad = good[0].copy()
+    bad[0] = 3.0
+    before = counters.get("numerics_nonfinite")
+    with knum.monitored(True):
+        with kserve.Server(engine) as server:
+            futs = [server.submit(r) for r in good]
+            bad_fut = server.submit(bad)
+            for f in futs:
+                f.result(30.0)
+            bad_ans = bad_fut.result(30.0)
+    assert np.isnan(bad_ans).any()  # answered, not altered
+    assert counters.get("numerics_nonfinite") - before >= 1
+    recs = knum.provenance_records()
+    assert any(
+        r["kind"] == "request" and str(bad_fut.request_id) in r["names"]
+        for r in recs
+    ), recs
+
+
+# -- conditioning monitor ------------------------------------------------------
+
+
+def test_condition_estimate_tracks_true_kappa():
+    rng = np.random.default_rng(7)
+    q, _ = np.linalg.qr(rng.standard_normal((48, 48)))
+    for true_k in (1e2, 1e4):
+        vals = np.geomspace(1.0, true_k, 48)
+        g = jnp.asarray((q * vals) @ q.T, jnp.float32)
+        row = knum.estimate_gram_condition(g, 0.0, "est")
+        # Ritz estimate lower-bounds true kappa, within ~one order.
+        assert row["kappa"] <= true_k * 1.1
+        assert row["kappa"] >= true_k / 20.0
+
+
+def test_cond_warn_fires_predictively_on_near_singular_gram():
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((256, 16)).astype(np.float32)
+    a = np.concatenate([a, a], axis=1)  # exact rank deficiency
+    g = jnp.asarray(a.T @ a)
+    before = counters.get("cond_warn")
+    row = knum.estimate_gram_condition(g, 0.0, "rankdef")
+    assert row["warned"]
+    assert counters.get("cond_warn") - before == 1
+
+
+def test_fit_report_carries_conditioning(rng):
+    x = jnp.asarray(rng.normal(size=(256, 64)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(256, 4)).astype(np.float32))
+    est = BlockLeastSquaresEstimator(32, 1, 1e-2)
+    with knum.monitored(True):
+        est.fit(x, y)
+    rep = est.last_fit_report
+    assert rep is not None and rep.conditioning
+    assert len(rep.conditioning) == 2  # two 32-wide blocks
+    for row in rep.conditioning:
+        assert row["kappa"] >= 1.0 and not row["warned"]
+    assert rep.record()["conditioning"] == rep.conditioning
+    # Off-mode fits carry None — no silent recompute.
+    est2 = BlockLeastSquaresEstimator(32, 1, 1e-2)
+    est2.fit(x, y)
+    assert est2.last_fit_report.conditioning is None
+
+
+def test_condition_estimate_never_raises_on_nonfinite_gram():
+    """A NaN gram is the very fault the solver's finite guard converts
+    into a typed error — the monitor must step aside (kappa=None), never
+    crash the recovery path."""
+    g = jnp.asarray(np.full((8, 8), np.nan, np.float32))
+    row = knum.estimate_gram_condition(g, 0.0, "nanprobe")
+    assert row["kappa"] is None and not row["warned"]
+    # The guarded solve still raises its TYPED error with monitoring on.
+    from keystone_tpu.solvers.normal_equations import solve_gram_l2
+
+    with knum.monitored(True):
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            solve_gram_l2(g, jnp.ones((8, 2), jnp.float32), 0.1)
+
+
+# -- output sketches + drift ---------------------------------------------------
+
+
+def test_class_histogram_sketch_roundtrip_and_divergence():
+    base = knum.OutputSketch.for_outputs(np.array([0, 0, 1, 1, 2, 2]))
+    assert base.kind == "class_histogram"
+    rec = base.record()
+    restored = knum.OutputSketch.from_record(json.loads(json.dumps(rec)))
+    same = knum.OutputSketch.for_outputs(np.array([0, 1, 2, 0, 1, 2]))
+    assert restored.divergence(same) == pytest.approx(0.0)
+    shifted = knum.OutputSketch.for_outputs(np.array([2] * 12))
+    assert restored.divergence(shifted) == pytest.approx(2.0 / 3.0)
+
+
+def test_quantile_sketch_divergence_is_scale_aware(rng):
+    vals = rng.normal(size=2000)
+    base = knum.OutputSketch.for_outputs(vals.astype(np.float32))
+    assert base.kind == "quantile"
+    rec = knum.OutputSketch.from_record(base.record())
+    same = knum.OutputSketch.for_outputs(vals.astype(np.float32))
+    assert rec.divergence(same) == pytest.approx(0.0, abs=1e-9)
+    moved = knum.OutputSketch.for_outputs((vals + 5.0).astype(np.float32))
+    assert rec.divergence(moved) > 1.0
+
+
+def test_drift_monitor_counts_once_and_rearms():
+    base = knum.OutputSketch.for_outputs(np.zeros(64, np.int64)).record()
+    mon = knum.DriftMonitor("drifter", base, tol=0.25)
+    before = counters.get("serve_output_drift")
+    mon.observe(np.ones(64, np.int64))  # TV = 1.0 — breach
+    mon.observe(np.ones(64, np.int64))  # still breached — latched, no recount
+    assert counters.get("serve_output_drift") - before == 1
+    assert mon.record()["drifted"] and mon.record()["breaches"] == 1
+    # Flood with baseline-matching answers until divergence < tol/2 — the
+    # latch re-arms and a NEW breach counts again.
+    for _ in range(40):
+        mon.observe(np.zeros(256, np.int64))
+    assert not mon.record()["drifted"]
+    for _ in range(80):
+        mon.observe(np.ones(512, np.int64))
+    assert counters.get("serve_output_drift") - before == 2
+
+
+def test_class_histogram_drift_detectable_after_long_healthy_prefix(rng):
+    """The class sketch windows too: a mix collapse AFTER thousands of
+    healthy answers must fire promptly — an accumulate-forever histogram
+    would dilute the shift by the healthy prefix's size."""
+    base = knum.OutputSketch.for_outputs(
+        rng.integers(0, 4, 512).astype(np.int64)
+    ).record()
+    mon = knum.DriftMonitor("late_class_drifter", base, tol=0.25)
+    for _ in range(20):  # 10k+ healthy answers — window saturated
+        mon.observe(rng.integers(0, 4, 512).astype(np.int64))
+    assert not mon.record()["drifted"]
+    before = counters.get("serve_output_drift")
+    for _ in range(10):  # the mix collapses onto one class
+        mon.observe(np.full(512, 2, np.int64))
+    assert mon.record()["drifted"]
+    assert counters.get("serve_output_drift") - before == 1
+
+
+def test_wide_range_integer_outputs_fall_to_quantile_sketch(rng):
+    """Negative or wide-range integer heads must NOT become per-value
+    class histograms (unbounded counts, ~1.0 TV over near-unique values)."""
+    neg = knum.OutputSketch.for_outputs(np.array([-3, 1, 2], np.int64))
+    assert neg.kind == "quantile"
+    wide = knum.OutputSketch.for_outputs(
+        rng.integers(0, 10**9, 256).astype(np.int64)
+    )
+    assert wide.kind == "quantile"
+    classes = knum.OutputSketch.for_outputs(np.array([0, 1, 2], np.int64))
+    assert classes.kind == "class_histogram"
+
+
+def test_quantile_drift_detectable_after_reservoir_saturation(rng):
+    """The live sketch is a SLIDING window: drift that begins only after
+    the first reservoir-full of healthy answers must still fire (a
+    fill-once reservoir would freeze on the healthy prefix forever)."""
+    vals = rng.normal(size=4096).astype(np.float32)
+    base = knum.OutputSketch.for_outputs(vals).record()
+    mon = knum.DriftMonitor("late_drifter", base, tol=0.25)
+    # Saturate the live window with healthy traffic first...
+    for _ in range(8):
+        mon.observe(rng.normal(size=1024).astype(np.float32))
+    assert not mon.record()["drifted"]
+    before = counters.get("serve_output_drift")
+    # ...then the mix moves: the window must roll onto the shifted values.
+    for _ in range(8):
+        mon.observe((rng.normal(size=1024) + 6.0).astype(np.float32))
+    assert mon.record()["drifted"]
+    assert counters.get("serve_output_drift") - before == 1
+
+
+def test_baseline_rides_checkpoint_and_arms_engine(tmp_path, rng):
+    stem = str(tmp_path / "drift_pipe")
+    baseline = knum.OutputSketch.for_outputs(
+        rng.normal(size=512).astype(np.float32)
+    ).record()
+    kckpt.save_pipeline(stem, Pipeline([Identity()]), numerics_baseline=baseline)
+    assert kckpt.load_numerics_baseline(stem) == json.loads(
+        json.dumps(baseline)
+    )
+    # load_pipeline itself is indifferent to the extra manifest entry.
+    assert isinstance(kckpt.load_pipeline(stem), Pipeline)
+    engine, _cold = kserve.load_engine(
+        stem, np.zeros(8, np.float32), label="armtest"
+    )
+    assert engine.drift is not None
+    assert engine.record()["drift"]["kind"] == "quantile"
+    # No-baseline artifacts arm nothing.
+    stem2 = str(tmp_path / "plain_pipe")
+    kckpt.save_pipeline(stem2, Pipeline([Identity()]))
+    assert kckpt.load_numerics_baseline(stem2) is None
+
+
+# -- /statusz + health_view ----------------------------------------------------
+
+
+def test_statusz_snapshot_schema_and_numerics_surface():
+    from keystone_tpu.core import telemetry
+
+    with knum.monitored(True):
+        knum.probe("statusz_site", np.ones(4, np.float32))
+        snap = telemetry.statusz_snapshot()
+    assert snap["schema"] == "keystone.statusz/1"
+    for key in ("providers", "slo", "numerics", "faults", "gauges"):
+        assert key in snap
+    assert "statusz_site" in snap["numerics"]["sites"]
+    json.dumps(snap)  # the page must be strict-JSON renderable
+
+
+def test_health_view_renders_all_sections(rng):
+    import health_view
+
+    with knum.monitored(True):
+        knum.probe("hv_site", np.ones((4, 2), np.float32))
+        knum.estimate_gram_condition(
+            jnp.asarray(np.eye(8, dtype=np.float32)), 0.0, "hv_solve"
+        )
+        base = knum.OutputSketch.for_outputs(np.zeros(64, np.int64)).record()
+        mon = knum.DriftMonitor("hv_engine", base, tol=0.25)
+        mon.observe(np.ones(64, np.int64))
+        doc = {"numerics": knum.snapshot()}
+    extracted = health_view.extract_numerics(doc)
+    text = health_view.render(extracted)
+    assert "hv_site" in text
+    assert "hv_solve" in text and "kappa" in text
+    assert "hv_engine" in text and "DRIFTED" in text
+    # The serving-record embedding path (engine/router drift) works too.
+    emb = health_view.extract_numerics(
+        {"engine": {"drift": mon.record()}}
+    )
+    assert "hv_engine" in health_view.render(emb)
+    # No numerics surface -> empty extraction (the CLI exits 2 there).
+    assert health_view.extract_numerics({"metric": "x"}) == {}

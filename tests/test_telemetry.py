@@ -182,6 +182,69 @@ def test_metrics_http_endpoint(tmp_path):
         server.shutdown()
 
 
+def test_statusz_and_healthz_endpoints():
+    """The /statusz debug page (ISSUE 15): one JSON snapshot of provider
+    state (router engines, ring/stream), SLO windows, and the numerics
+    observatory — golden-pinned schema; /healthz answers liveness."""
+    from keystone_tpu.core import numerics as knum
+
+    telemetry.register_statusz("probe_provider", lambda: {"engines": 2})
+    telemetry.register_statusz(
+        "sick_provider", lambda: (_ for _ in ()).throw(RuntimeError("down"))
+    )
+    trace.metrics.gauge("statusz_probe_gauge", 7)
+    server = telemetry.start_metrics_server(0)
+    try:
+        port = server.server_address[1]
+        health = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ).read()
+        )
+        assert health == {"ok": True}
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statusz", timeout=10
+        )
+        assert resp.headers["Content-Type"] == "application/json"
+        doc = json.loads(resp.read())
+        # Golden schema: the keys operators script against.
+        assert doc["schema"] == "keystone.statusz/1"
+        assert set(doc) >= {
+            "schema", "time_unix", "pid", "providers", "slo", "numerics",
+            "faults", "counters", "gauges",
+        }
+        assert doc["providers"]["probe_provider"] == {"engines": 2}
+        # One sick provider reports its error without blanking the page.
+        assert "RuntimeError" in doc["providers"]["sick_provider"]["error"]
+        assert doc["gauges"]["statusz_probe_gauge"] == 7
+        assert set(doc["numerics"]) >= {
+            "active", "sites", "conditioning", "provenance", "drift",
+        }
+        assert doc["pid"] == os.getpid()
+    finally:
+        server.shutdown()
+        telemetry.unregister_statusz("probe_provider")
+        telemetry.unregister_statusz("sick_provider")
+        del knum
+
+
+def test_statusz_carries_router_and_stream_state(tmp_path):
+    """Routers and ingest streams self-register as /statusz providers and
+    unregister on close — the page shows the CURRENT topology."""
+    from keystone_tpu.core import frontend as kfrontend
+
+    router = kfrontend.ShapeRouter(label="statusz_router")
+    try:
+        snap = telemetry.statusz_snapshot()
+        assert "router:statusz_router" in snap["providers"]
+        assert snap["providers"]["router:statusz_router"]["engines"] == {}
+    finally:
+        router.close()
+    assert "router:statusz_router" not in (
+        telemetry.statusz_snapshot()["providers"]
+    )
+
+
 # -- postmortem dumps ---------------------------------------------------------
 
 

@@ -111,6 +111,16 @@ DEFAULT_METRICS: tuple = (
     # is enforced in-round by the record itself).
     ("extra_metrics.profiler.solve_mfu", "higher", 0.30),
     ("extra_metrics.serving.profiler_overhead.p99_on_ms", "lower", 0.50),
+    # ISSUE 15: the numerics observatory's serving cost — the probed-serve
+    # p99 and the probe overhead fraction are both lower-is-better, so an
+    # observatory that starts costing the endpoint real tail latency
+    # across rounds fails loudly (the <= 5% acceptance bound is enforced
+    # in-round by the record's target_frac).
+    ("extra_metrics.numerics.probed_serve_p99_ms", "lower", 0.50),
+    (
+        "extra_metrics.numerics.probe_overhead.probe_overhead_frac",
+        "lower", 1.00,
+    ),
 )
 
 

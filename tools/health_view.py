@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Numeric-health viewer: render the numerics observatory's surface —
+per-node/site tensor stats, κ per solve, drift verdicts, NaN provenance —
+as human tables from any artifact that embeds it.
+
+Accepts (auto-detected, first match wins):
+
+* a flight-recorder postmortem dump (``keystone.postmortem/1``) — reads
+  ``metrics.numerics``;
+* a ``/statusz`` snapshot (``keystone.statusz/1``) — reads ``numerics``;
+* a bench round record (``BENCH_r*.json``, raw or driver-wrapped) — reads
+  ``metrics.numerics`` plus the ``extra_metrics.numerics`` section and any
+  per-solve ``conditioning`` in fit reports;
+* a workload results / serving record holding ``numerics`` /
+  ``output_drift`` / ``conditioning`` keys.
+
+Usage:
+    python tools/health_view.py postmortem_serve_output_drift_123_0.json
+    python tools/health_view.py BENCH_r06.json
+
+Exit status: 0 = rendered, 2 = no numerics surface found in the document.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fmt(v, nd: int = 4) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def _table(headers: list[str], rows: list[list[str]]) -> str:
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+    out = [line(headers), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def extract_numerics(doc) -> dict:
+    """Pull every numerics-observatory fragment out of ``doc`` into one
+    ``{"sites", "conditioning", "provenance", "drift"}`` dict (keys absent
+    when the artifact carries nothing for them)."""
+    if not isinstance(doc, dict):
+        return {}
+    # driver-wrapped bench round: {"parsed": <record>, "tail": ...}
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    candidates = []
+    for path in (
+        ("numerics",),                      # statusz / results / snapshot()
+        ("metrics", "numerics"),            # postmortem / bench metrics
+        ("extra_metrics", "numerics"),      # the bench numerics section
+    ):
+        node = doc
+        for part in path:
+            node = node.get(part) if isinstance(node, dict) else None
+        if isinstance(node, dict):
+            candidates.append(node)
+    out: dict = {}
+    for cand in candidates:
+        for key in ("sites", "conditioning", "provenance", "drift"):
+            if cand.get(key) and key not in out:
+                out[key] = cand[key]
+    # drift verdicts embedded by serve_bench / engine / router records
+    drifts = out.setdefault("drift", {})
+    def adopt_drift(rec):
+        if isinstance(rec, dict) and "divergence" in rec:
+            drifts.setdefault(rec.get("label", "engine"), rec)
+    adopt_drift(doc.get("output_drift"))
+    engine = doc.get("engine")
+    if isinstance(engine, dict):
+        adopt_drift(engine.get("drift"))
+    router = doc.get("router")
+    if isinstance(router, dict):
+        for eng in (router.get("engines") or {}).values():
+            if isinstance(eng, dict):
+                adopt_drift(eng.get("drift"))
+    if not drifts:
+        out.pop("drift", None)
+    # per-solve conditioning riding fit reports / bench sections
+    if "conditioning" not in out:
+        for key in ("fit_report", "last_fit_report", "solve"):
+            rep = doc.get(key)
+            if isinstance(rep, dict) and rep.get("conditioning"):
+                out["conditioning"] = rep["conditioning"]
+                break
+    return {k: v for k, v in out.items() if v}
+
+
+def render(numerics: dict) -> str:
+    """The numeric-health report as one printable string."""
+    parts: list[str] = []
+    sites = numerics.get("sites") or {}
+    if sites:
+        rows = []
+        for site in sorted(sites):
+            s = sites[site]
+            last = s.get("last", {})
+            rows.append([
+                site,
+                _fmt(s.get("sampled")),
+                _fmt(last.get("mean")),
+                _fmt(last.get("std")),
+                _fmt(last.get("min")),
+                _fmt(last.get("max")),
+                _fmt(last.get("abs_max")),
+                _fmt(last.get("zero_frac")),
+                _fmt(s.get("nonfinite_total", last.get("nonfinite"))),
+            ])
+        parts.append("== tensor-stat probe sites ==\n" + _table(
+            ["site", "sampled", "mean", "std", "min", "max", "abs_max",
+             "zero_frac", "nonfinite"],
+            rows,
+        ))
+    cond = numerics.get("conditioning") or []
+    if cond:
+        rows = [
+            [
+                _fmt(r.get("label")),
+                _fmt(r.get("block", "-")),
+                _fmt(r.get("dim")),
+                _fmt(r.get("kappa"), 3),
+                _fmt(r.get("lam_max"), 3),
+                _fmt(r.get("lam_min"), 3),
+                _fmt(r.get("lam"), 3),
+                "WARN" if r.get("warned") else "ok",
+            ]
+            for r in cond
+        ]
+        parts.append("== conditioning (kappa per solve) ==\n" + _table(
+            ["solve", "block", "dim", "kappa", "lam_max", "lam_min",
+             "lam", "verdict"],
+            rows,
+        ))
+    drift = numerics.get("drift") or {}
+    if drift:
+        rows = [
+            [
+                label,
+                _fmt(d.get("kind")),
+                _fmt(d.get("observed")),
+                _fmt(d.get("divergence")),
+                _fmt(d.get("tol")),
+                "DRIFTED" if d.get("drifted") else "ok",
+                _fmt(d.get("breaches")),
+            ]
+            for label, d in sorted(drift.items())
+        ]
+        parts.append("== serving output drift ==\n" + _table(
+            ["engine", "sketch", "answers", "divergence", "tol",
+             "verdict", "breaches"],
+            rows,
+        ))
+    prov = numerics.get("provenance") or []
+    if prov:
+        rows = [
+            [
+                _fmt(p.get("site")),
+                _fmt(p.get("kind")),
+                ",".join(str(r) for r in p.get("rows", [])[:8]),
+                ", ".join(p.get("names", [])[:6])
+                + ("..." if len(p.get("names", [])) > 6 else ""),
+            ]
+            for p in prov
+        ]
+        parts.append("== non-finite provenance ==\n" + _table(
+            ["site", "kind", "rows", "names"], rows,
+        ))
+    return "\n\n".join(parts)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("health_view")
+    p.add_argument(
+        "record",
+        help="postmortem dump, /statusz snapshot, bench round, or workload "
+        "results JSON",
+    )
+    a = p.parse_args(argv)
+    try:
+        with open(a.record) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"health_view: cannot read {a.record}: {e}", file=sys.stderr)
+        return 2
+    numerics = extract_numerics(doc)
+    if not numerics:
+        print(
+            f"health_view: no numerics surface in {a.record} — was the run "
+            "monitored (KEYSTONE_NUMERICS=1)?",
+            file=sys.stderr,
+        )
+        return 2
+    print(render(numerics))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -298,10 +298,18 @@ def main(argv=None) -> int:
     p.add_argument("--shift", action="store_true",
                    help="with --wire: replay a shape-mix shift (warm add "
                    "+ retire over a live socket)")
+    p.add_argument(
+        "--numerics", action="store_true",
+        help="turn the numerics observatory on for the run "
+        "(KEYSTONE_NUMERICS equivalent): per-bucket output probes + drift "
+        "verdicts land in the record's router/numerics sections",
+    )
     p.add_argument("--timeout", type=float, default=120.0)
     a = p.parse_args(argv)
 
-    from keystone_tpu.core import frontend, trace, wire
+    import contextlib
+
+    from keystone_tpu.core import frontend, numerics as knum, trace, wire
 
     shapes = parse_shapes(a.shapes)
     cfg = frontend.RouterConfig.from_env(warm_threshold=2, min_engines=1)
@@ -316,7 +324,9 @@ def main(argv=None) -> int:
         toy_engine, label="serve_bench", config=cfg
     )
     ok = True
+    numerics_ctx = knum.monitored(True) if a.numerics else contextlib.nullcontext()
     try:
+        numerics_ctx.__enter__()
         for shape in shapes:
             router.add_engine(toy_engine(shape))
         record["engine_build_seconds"] = round(time.perf_counter() - t0, 3)
@@ -357,7 +367,12 @@ def main(argv=None) -> int:
             if k in overhead
         }
         record["router"] = router.record()
+        if a.numerics:
+            # The observatory's view of the benched traffic (ISSUE 15):
+            # per-site output stats + any drift verdicts.
+            record["numerics"] = knum.snapshot()
     finally:
+        numerics_ctx.__exit__(None, None, None)
         router.close()
     record["ok"] = bool(ok)
     record["seconds"] = round(time.perf_counter() - t0, 3)
