@@ -2166,6 +2166,80 @@ def bench_serving(rng):
             )
         finally:
             router.close()
+
+        # -- elastic serving (ISSUE 16): ckpt -> foreign mesh -> serve ----
+        # The mnist_fft artifact saved above is RELOADED onto an explicit
+        # smaller mesh (load_pipeline(mesh=) resharding + mesh-native AOT),
+        # served bit-equal against the warm engine's offline oracle, then a
+        # MeshEngineFactory-backed router is shrunk mid-flight with requests
+        # straddling the swap.  bench_diff regresses on
+        # serving.reshard_wall_s and pins serving.reanchor_dropped_requests
+        # at zero.
+        from keystone_tpu.parallel.mesh import make_mesh, mesh_desc
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            out["reshard"] = {"skipped": "single-device host"}
+        else:
+            n_full = 4 if len(devs) >= 4 else 2
+            full = make_mesh(data=n_full, model=1, devices=devs[:n_full])
+            surviving = make_mesh(
+                data=n_full // 2, model=1, devices=devs[: n_full // 2]
+            )
+            stem = os.path.join(tmp, "mnist_fft_pipe")
+            reqs = x[:64]
+            oracle = np.asarray(engines["mnist_fft"].offline(reqs))
+
+            t0 = time.perf_counter()
+            foreign, fcold = kserve.load_engine(
+                stem, jax.ShapeDtypeStruct((d,), np.float32),
+                config=cfg, label="mnist_fft_foreign", mesh=surviving,
+            )
+            answers = np.asarray(foreign.infer(reqs))
+            reshard_wall = time.perf_counter() - t0
+
+            # Live device-loss drill: requests in flight across the shrink;
+            # every one must answer — dropped stays 0 across rounds.
+            factory = kfrontend.MeshEngineFactory(
+                lambda shape, dtype, m: kserve.load_engine(
+                    stem, jax.ShapeDtypeStruct(shape, dtype),
+                    config=cfg, label="mnist_fft_elastic", mesh=m,
+                )[0],
+                mesh=full,
+            )
+            drill_router = kfrontend.ShapeRouter(
+                factory, label="bench_reanchor"
+            )
+            dropped, got = 0, []
+            try:
+                drill_router.add_engine(factory((d,), np.dtype(np.float32)))
+                futs = [drill_router.submit(r) for r in reqs[:16]]
+                rrec = drill_router.reanchor(
+                    surviving, why="bench device-loss drill"
+                )
+                futs += [drill_router.submit(r) for r in reqs[16:32]]
+                for f in futs:
+                    try:
+                        got.append(np.asarray(f.result(120.0)))
+                    except Exception:  # noqa: BLE001 — counted as dropped
+                        dropped += 1
+            finally:
+                drill_router.close()
+
+            out["reshard"] = {
+                "full_mesh": mesh_desc(full),
+                "surviving_mesh": mesh_desc(surviving),
+                "cold_start": fcold,
+                "round_trip_bit_equal": bool(np.array_equal(answers, oracle)),
+                "reanchor": rrec,
+                "drill_requests": 32,
+                "drill_bit_equal": bool(
+                    len(got) == 32
+                    and np.array_equal(np.stack(got), oracle[:32])
+                ),
+            }
+            out["reshard_wall_s"] = round(reshard_wall, 4)
+            out["reanchor_dropped_requests"] = dropped
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     return out

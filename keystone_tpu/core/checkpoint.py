@@ -19,6 +19,8 @@ leaves a half-written artifact that a later ``load_or_fit`` would trust.
 Public surface:
   save_pipeline(path, pipe)   -> writes <stem>.npz + <stem>.json
   load_pipeline(path)         -> rebuilt object (arrays as jax.Arrays)
+  load_pipeline(path, mesh=M) -> same, with every array leaf redistributed
+                                 onto mesh M (topology-portable restore)
   checkpoint_exists(path)     -> bool (both files present)
   load_or_fit(path, est, *a)  -> load if present, else fit + save
 """
@@ -45,6 +47,13 @@ FORMAT_VERSION = 1
 # dtypes numpy serializes natively inside an .npz; anything else (bfloat16,
 # fp8, ...) is stored as raw bytes and re-viewed on load.
 _NATIVE_KINDS = frozenset("biufc")
+
+#: Transfer granularity of the reshard loader: arrays larger than this go
+#: host-staged shard-by-shard (jax.make_array_from_callback) instead of one
+#: whole-array device_put, so the transient footprint of a restore stays
+#: bounded even when no single device could stage the whole array.
+RESHARD_CHUNK_ENV = "KEYSTONE_RESHARD_CHUNK_BYTES"
+_DEFAULT_RESHARD_CHUNK = 64 * 2**20
 
 
 class CheckpointError(RuntimeError):
@@ -80,6 +89,35 @@ def _is_replicated(v) -> bool:
         return len(v.sharding.device_set) <= 1 or v.is_fully_replicated
     except Exception:  # noqa: BLE001 — unknown sharding: assume sharded
         return False
+
+
+def _sharding_spec(v) -> str:
+    """The autoshard spec string (``'replicated'`` / ``'data@dimN'`` /
+    ``'model@dimN'``) an array leaf is laid out as — what the manifest
+    records per array so a reshard load can re-lower the SAME layout onto
+    whatever mesh survived.  A sharding outside that vocabulary (multi-axis
+    partitioning, foreign axis names) records as ``'opaque'``; the reshard
+    loader places those replicated."""
+    if _is_replicated(v):
+        return "replicated"
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    try:
+        pspec = tuple(v.sharding.spec)
+    except Exception:  # noqa: BLE001 — non-NamedSharding layouts
+        return "opaque"
+    parts: list[tuple[str, int]] = []
+    for i, part in enumerate(pspec):
+        names = (
+            part if isinstance(part, tuple)
+            else ((part,) if part is not None else ())
+        )
+        parts.extend((str(name), i) for name in names)
+    if not parts:
+        return "replicated"
+    if len(parts) == 1 and parts[0][0] in (DATA_AXIS, MODEL_AXIS):
+        return f"{parts[0][0]}@dim{parts[0][1]}"
+    return "opaque"
 
 
 def checkpoint_paths(path: str) -> tuple[str, str]:
@@ -147,10 +185,15 @@ class _Encoder:
     def add_array(self, v) -> str:
         key = f"a{self._n}"
         self._n += 1
+        sharding = _sharding_spec(v)
         if not _is_replicated(v):
             self.all_replicated = False
         arr = np.asarray(jax.device_get(v))
         spec = {"dtype": arr.dtype.name, "shape": list(arr.shape)}
+        if sharding != "replicated":
+            # Per-array layout provenance (absent == replicated): the
+            # reshard loader re-lowers this spec onto the target mesh.
+            spec["sharding"] = sharding
         if arr.dtype.kind not in _NATIVE_KINDS:
             # raw-bytes transport for npz-hostile dtypes (e.g. bfloat16)
             spec["raw"] = True
@@ -225,7 +268,7 @@ class _Encoder:
         )
 
 
-def _decode(spec: dict, arrays, array_specs: dict, where: str) -> Any:
+def _decode(spec: dict, arrays, array_specs: dict, where: str, put=None) -> Any:
     t = spec.get("t")
     if t == "none":
         return None
@@ -251,25 +294,27 @@ def _decode(spec: dict, arrays, array_specs: dict, where: str) -> Any:
                 f"manifest says {aspec['dtype']}{aspec['shape']} — artifact "
                 "corrupt or schema drift"
             )
-        return jnp.asarray(arr)
+        # ``put`` is the reshard hook (load_pipeline(mesh=)): it places the
+        # host array onto the target mesh instead of the default device.
+        return put(arr, key, where) if put is not None else jnp.asarray(arr)
     if t == "dtype":
         dt = np.dtype(spec["v"])
         return dt.type if spec.get("as_type") else dt
     if t in ("list", "tuple"):
         vals = [
-            _decode(s, arrays, array_specs, f"{where}[{i}]")
+            _decode(s, arrays, array_specs, f"{where}[{i}]", put)
             for i, s in enumerate(spec["v"])
         ]
         return tuple(vals) if t == "tuple" else vals
     if t == "dict":
         return {
-            k: _decode(s, arrays, array_specs, f"{where}[{k!r}]")
+            k: _decode(s, arrays, array_specs, f"{where}[{k!r}]", put)
             for k, s in spec["v"].items()
         }
     if t == "pipeline":
         return Pipeline(
             [
-                _decode(s, arrays, array_specs, f"{where}.nodes[{i}]")
+                _decode(s, arrays, array_specs, f"{where}.nodes[{i}]", put)
                 for i, s in enumerate(spec["nodes"])
             ]
         )
@@ -277,11 +322,13 @@ def _decode(spec: dict, arrays, array_specs: dict, where: str) -> Any:
         from ..solvers.block import BlockLinearMapper
 
         return BlockLinearMapper(
-            list(_decode(spec["xs"], arrays, array_specs, f"{where}.xs")),
+            list(_decode(spec["xs"], arrays, array_specs, f"{where}.xs", put)),
             int(spec["block_size"]),
-            _decode(spec["b"], arrays, array_specs, f"{where}.b"),
+            _decode(spec["b"], arrays, array_specs, f"{where}.b", put),
             list(
-                _decode(spec["scalers"], arrays, array_specs, f"{where}.scalers")
+                _decode(
+                    spec["scalers"], arrays, array_specs, f"{where}.scalers", put
+                )
             ),
         )
     if t == "node":
@@ -306,14 +353,119 @@ def _decode(spec: dict, arrays, array_specs: dict, where: str) -> Any:
         obj = object.__new__(cls)
         for f in data_fields:
             object.__setattr__(
-                obj, f, _decode(spec["data"][f], arrays, array_specs, f"{where}.{f}")
+                obj,
+                f,
+                _decode(spec["data"][f], arrays, array_specs, f"{where}.{f}", put),
             )
         for f in meta_fields:
             object.__setattr__(
-                obj, f, _decode(spec["meta"][f], arrays, array_specs, f"{where}.{f}")
+                obj,
+                f,
+                _decode(spec["meta"][f], arrays, array_specs, f"{where}.{f}", put),
             )
         return obj
     raise CheckpointError(f"{where}: unknown manifest entry type {t!r}")
+
+
+class _Resharder:
+    """Redistributes checkpointed host arrays onto a TARGET mesh — the
+    ``load_pipeline(mesh=)`` placement engine.
+
+    Per array: the recorded spec (manifest ``"sharding"``) is re-lowered
+    onto the new mesh when its named dimension still divides there, else the
+    array lands replicated; every placement is charged analytically against
+    the target's min per-chip budget (``memory.plan_bytes`` — the
+    plan_program-style admission without a compile).  A replicated placement
+    denied per-chip falls back to the best dividing spec (the "no common
+    device fits a whole array" tier); a placement nothing admits is a TYPED
+    ``CheckpointError``, never an OOM mid-restore.  Arrays above
+    ``KEYSTONE_RESHARD_CHUNK_BYTES`` transfer host-staged shard-by-shard via
+    ``jax.make_array_from_callback`` so the transient footprint stays
+    bounded by one shard, not one whole array."""
+
+    def __init__(self, mesh, array_specs: dict, manifest_path: str):
+        from . import memory as kmem
+
+        self.mesh = mesh
+        self.mesh_shape = dict(mesh.shape)
+        self.array_specs = array_specs
+        self.manifest_path = manifest_path
+        raw = os.environ.get(RESHARD_CHUNK_ENV, "").strip()
+        self.chunk_bytes = (
+            kmem.parse_bytes(raw) if raw else _DEFAULT_RESHARD_CHUNK
+        )
+        # One budget read per load: admission below is analytic and the
+        # mesh does not change mid-restore.
+        self.budget, _ = kmem.min_chip_budget(mesh)
+        self.stats = {
+            "arrays": 0, "resharded": 0, "host_staged": 0,
+            "spec_fallback": 0, "bytes": 0,
+        }
+
+    def _target_spec(self, arr: np.ndarray, recorded: str) -> str:
+        from . import autoshard
+
+        if recorded not in ("replicated", "opaque"):
+            try:
+                autoshard.spec_pspec(recorded, arr.ndim)
+                autoshard.spec_chip_bytes(
+                    arr.shape, arr.dtype, recorded, self.mesh_shape
+                )
+                return recorded
+            except ValueError:
+                pass  # recorded dim no longer divides: replicate instead
+        return "replicated"
+
+    def put(self, arr: np.ndarray, key: str, where: str):
+        from . import autoshard
+        from . import memory as kmem
+
+        recorded = self.array_specs.get(key, {}).get("sharding", "replicated")
+        spec = self._target_spec(arr, recorded)
+        self.stats["arrays"] += 1
+        per_chip = autoshard.spec_chip_bytes(
+            arr.shape, arr.dtype, spec, self.mesh_shape
+        )
+        plan = kmem.plan_bytes(
+            f"ckpt_reshard:{key}",
+            output_bytes=per_chip,
+            mesh=self.mesh,
+            budget=self.budget,
+        )
+        if not plan.admitted and spec == "replicated":
+            # No chip fits the whole array: shard it instead — the
+            # host-staged fallback tier of the reshard ladder.
+            cand = autoshard.best_spec(arr, self.mesh_shape)
+            if cand["spec"] != "replicated":
+                spec = cand["spec"]
+                per_chip = int(cand["per_chip_bytes"])
+                self.stats["spec_fallback"] += 1
+                plan = kmem.plan_bytes(
+                    f"ckpt_reshard:{key}:{spec}",
+                    output_bytes=per_chip,
+                    mesh=self.mesh,
+                    budget=self.budget,
+                )
+        if not plan.admitted:
+            raise CheckpointError(
+                f"{where}: array {key!r} "
+                f"({arr.dtype.name}{list(arr.shape)}) does not fit the "
+                f"target mesh — {kmem.fmt_bytes(per_chip)}/chip under spec "
+                f"{spec!r} vs budget "
+                f"{kmem.fmt_bytes(self.budget or 0)} ({plan.reason})"
+            )
+        sharding = autoshard.spec_sharding(spec, self.mesh, arr.ndim)
+        if spec != "replicated" or recorded != "replicated":
+            self.stats["resharded"] += 1
+        self.stats["bytes"] += int(arr.nbytes)
+        if arr.nbytes > self.chunk_bytes and arr.ndim:
+            # Host-staged, per-shard transfer: each device receives only
+            # its own slice, one shard in flight at a time.
+            self.stats["host_staged"] += 1
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx]
+            )
+        return jax.device_put(arr, sharding)
 
 
 def save_pipeline(path: str, pipe, numerics_baseline: dict | None = None) -> str:
@@ -386,10 +538,21 @@ def _ensure_standard_registry() -> None:
             _logger.warning("registry bootstrap: could not import %s: %s", mod, e)
 
 
-def load_pipeline(path: str):
+def load_pipeline(path: str, mesh=None):
     """Rebuild a fitted node/pipeline saved by :func:`save_pipeline`.
     Validates format version and every array's dtype/shape against the
-    manifest before constructing anything."""
+    manifest before constructing anything.
+
+    ``mesh``: the topology-portable restore path.  ``None`` (the default)
+    keeps the strict posture — sharded state recorded under a different
+    topology raises the typed :class:`CheckpointMismatch` instead of
+    resharding silently.  Passing a target ``jax.sharding.Mesh``
+    OPTS IN to redistribution: every array leaf is placed onto that mesh
+    (its recorded spec re-lowered where it still divides, replicated
+    otherwise), each placement admitted per-chip (``memory.plan_bytes``)
+    and transferred chunked/host-staged above
+    ``KEYSTONE_RESHARD_CHUNK_BYTES`` — see :class:`_Resharder`.  A
+    placement no tier admits is a typed ``CheckpointError``."""
     _ensure_standard_registry()
     npz_path, manifest_path = checkpoint_paths(path)
     try:
@@ -407,16 +570,20 @@ def load_pipeline(path: str):
             f"(this build reads {FORMAT_VERSION})"
         )
     recorded = manifest.get("topology")
-    if recorded is not None and not manifest.get("all_replicated", True):
+    if mesh is not None:
+        pass  # explicit reshard target: the topology guard is satisfied below
+    elif recorded is not None and not manifest.get("all_replicated", True):
         # Sharded state is only restorable onto the topology it was
         # solved on; anything else must fail TYPED, not reshard silently.
         current = _current_topology()
         if recorded != current:
             raise CheckpointMismatch(
                 f"{manifest_path}: checkpoint holds sharded (non-replicated) "
-                f"arrays solved on topology {recorded}, but this process is "
-                f"{current} — refusing to silently reshard; load on the "
-                "recorded topology or re-fit"
+                f"arrays recorded under topology {recorded} but this process "
+                f"is {current} — refusing to silently reshard.  Pass "
+                "load_pipeline(..., mesh=<target Mesh>) to redistribute the "
+                "state onto the mesh you have, load on the recorded "
+                "topology, or re-fit"
             )
     elif recorded is None:
         _logger.warning(
@@ -448,8 +615,34 @@ def load_pipeline(path: str):
         raise CheckpointError(
             f"{npz_path}: arrays {sorted(extra)} named in manifest are missing"
         )
-    obj = _decode(manifest["root"], arrays, manifest["arrays"], "root")
-    _logger.info("loaded checkpoint %s (%d arrays)", npz_path, len(arrays))
+    resharder = (
+        _Resharder(mesh, manifest["arrays"], manifest_path)
+        if mesh is not None
+        else None
+    )
+    obj = _decode(
+        manifest["root"], arrays, manifest["arrays"], "root",
+        resharder.put if resharder is not None else None,
+    )
+    if resharder is not None and resharder.stats["arrays"]:
+        from ..parallel.mesh import mesh_desc
+        from .resilience import counters
+
+        st = resharder.stats
+        counters.record(
+            "ckpt_reshard",
+            f"{npz_path}: {st['arrays']} array(s) "
+            f"({st['bytes']} B) placed onto mesh {mesh_desc(mesh)} "
+            f"[{st['resharded']} resharded, {st['host_staged']} "
+            f"host-staged, {st['spec_fallback']} spec-fallback]",
+        )
+        _logger.info(
+            "loaded checkpoint %s resharded onto mesh %s (%d arrays, "
+            "%d host-staged)",
+            npz_path, mesh_desc(mesh), st["arrays"], st["host_staged"],
+        )
+    else:
+        _logger.info("loaded checkpoint %s (%d arrays)", npz_path, len(arrays))
     return obj
 
 

@@ -31,7 +31,19 @@ that turns the single-shape engines into one multi-shape service:
   ``router_admission_denied``, answered :class:`RetryLater`) when the new
   engine's peak-bucket bytes plus every live engine's would overrun the
   shared budget.  Denial is backpressure, not death — a later retire frees
-  the headroom and the retry succeeds.
+  the headroom and the retry succeeds.  On a mesh-anchored router the
+  budget is the anchor mesh's ``min_chip_budget`` — after a re-anchor the
+  sum re-runs against the SURVIVING mesh's smallest chip, never the dead
+  topology's.
+* **Surviving-mesh re-anchor** (ISSUE 16) — :class:`MeshEngineFactory`
+  walks the solvers' degradation ladder (full mesh → ``reduced_mesh`` →
+  single device) when a tier's build fails, and
+  :meth:`ShapeRouter.reanchor` hot-swaps every live engine onto a new
+  (typically smaller, surviving) mesh through the same warm-add/
+  drained-retire loop a mix shift uses: each replacement is built and
+  registered BEFORE its predecessor is unrouted, the predecessor then
+  drains (every outstanding future resolves) and closes — zero request
+  loss across the reshard, counted ``mesh_reanchor`` (postmortem-linked).
 
 Router state exports into ``trace.metrics`` (``router_engines`` gauge,
 ``router_routes``/``router_misses``/``router_warm_adds``/
@@ -68,6 +80,7 @@ from . import memory as kmem
 from . import numerics as knum
 from . import telemetry
 from . import trace
+from ..parallel import mesh as kmesh
 from .resilience import counters
 from .serve import (
     ServeConfig,
@@ -222,6 +235,98 @@ class _Entry:
         self.routes = 0
 
 
+class MeshEngineFactory:
+    """Mesh-aware engine factory (ISSUE 16): builds engines anchored on a
+    target mesh, walking the solvers' ``_fit_mesh_ladder`` degradation
+    tiers — anchor mesh → ``reduced_mesh`` (same devices, model axis
+    collapsed) → single-device floor — when a tier's build raises a typed
+    :class:`~.serve.ServeError` (per-chip admission denial, no surviving
+    bucket).  Each step down is counted ``router_mesh_stepdown``; only
+    when the single-device floor also fails does the factory raise.
+
+    ``build(shape, dtype, mesh_or_none) -> ServingEngine`` constructs one
+    engine on one tier (``None`` = meshless single-device engine).  The
+    anchor moves with the substrate: :meth:`ShapeRouter.reanchor` calls
+    :meth:`set_mesh` with the surviving mesh, and every later build walks
+    the NEW ladder.
+    """
+
+    def __init__(self, build, mesh=None):
+        self._build = build
+        self._mesh_lock = threading.Lock()
+        self._mesh = mesh
+
+    @property
+    def mesh(self):
+        with self._mesh_lock:
+            return self._mesh
+
+    def set_mesh(self, mesh) -> None:
+        """Move the anchor (the surviving mesh after device loss)."""
+        with self._mesh_lock:
+            self._mesh = mesh
+
+    def _ladder(self) -> list:
+        mesh = self.mesh
+        tiers = []
+        if mesh is not None:
+            tiers.append(mesh)
+            reduced = kmesh.reduced_mesh(mesh)
+            if reduced is not None:
+                tiers.append(reduced)
+        tiers.append(None)  # single-device floor: a meshless engine
+        return tiers
+
+    @staticmethod
+    def _tier_desc(tier) -> str:
+        return kmesh.mesh_desc(tier) if tier is not None else "single-device"
+
+    @staticmethod
+    def _denied_bucket(engine: ServingEngine) -> int | None:
+        """A live bucket that only survived as the engine's denied floor
+        (``ServingEngine`` keeps the floor bucket when preflight denies it
+        rather than dying) — on a mesh tier that is per-chip admission
+        failure, and a lower tier should be tried instead."""
+        live = set(engine.buckets())
+        for bucket, plan in engine.memory_plans.items():
+            if bucket in live and not plan.admitted:
+                return bucket
+        return None
+
+    def __call__(self, shape, dtype) -> ServingEngine:
+        key = tuple(int(d) for d in shape)
+        tiers = self._ladder()
+        last_err: ServeError | None = None
+        for i, tier in enumerate(tiers):
+            try:
+                engine = self._build(key, np.dtype(dtype), tier)
+                denied = (
+                    self._denied_bucket(engine) if tier is not None else None
+                )
+                if denied is None or i + 1 >= len(tiers):
+                    return engine
+                counters.record(
+                    "router_mesh_stepdown",
+                    f"engine for shape {key} on mesh "
+                    f"{self._tier_desc(tier)} only serves through its "
+                    f"DENIED floor bucket {denied} (per-chip admission) — "
+                    f"stepping down to {self._tier_desc(tiers[i + 1])}",
+                )
+            except ServeError as e:
+                last_err = e
+                if i + 1 < len(tiers):
+                    counters.record(
+                        "router_mesh_stepdown",
+                        f"engine for shape {key} failed to build on mesh "
+                        f"{self._tier_desc(tier)} ({e}) — stepping down to "
+                        f"{self._tier_desc(tiers[i + 1])}",
+                    )
+        raise ServingUnavailable(
+            f"engine for shape {key} failed on every mesh tier "
+            f"({', '.join(self._tier_desc(t) for t in tiers)}): {last_err}"
+        ) from last_err
+
+
 class ShapeRouter:
     """The multi-shape serving front-end: submit any supported-shape
     request, get a :class:`~.serve.ServeFuture` from the matching engine's
@@ -242,8 +347,19 @@ class ShapeRouter:
         config: RouterConfig | None = None,
         server_config: ServeConfig | None = None,
         clock=time.monotonic,
+        mesh=None,
     ):
         self._factory = engine_factory
+        # The router's anchor mesh: cross-engine admission budgets against
+        # ITS smallest chip (not the global hbm_budget), and reanchor()
+        # moves it.  A MeshEngineFactory and the router share one anchor.
+        if isinstance(engine_factory, MeshEngineFactory):
+            if mesh is not None:
+                engine_factory.set_mesh(mesh)
+            else:
+                mesh = engine_factory.mesh
+        self._mesh = mesh
+        self._last_reanchor: dict | None = None
         self.label = label
         self.config = config or RouterConfig.from_env()
         self._server_config = server_config
@@ -527,8 +643,17 @@ class ShapeRouter:
         candidate RESERVES its bytes under the same lock acquisition, so
         two concurrent warms for different shapes cannot both pass against
         the same headroom; the reservation clears once the engine is in
-        the routing table (the ``_miss`` finally)."""
-        budget = kmem.hbm_budget()
+        the routing table (the ``_miss`` finally).
+
+        A mesh-anchored router budgets against the CURRENT anchor mesh's
+        smallest chip (``min_chip_budget``): after a re-anchor the sum
+        re-runs against the surviving topology — a budget computed on the
+        dead mesh would over-admit (ISSUE 16)."""
+        mesh = self._mesh
+        if mesh is not None:
+            budget, _ = kmem.min_chip_budget(mesh)
+        else:
+            budget = kmem.hbm_budget()
         candidate = self._engine_peak_bytes(new_engine)
         with self._lock:
             resident = sum(
@@ -621,6 +746,114 @@ class ShapeRouter:
         for entry in retired:
             self._retire_entry(entry, why="stopped earning traffic")
         return {"retired": [list(e.key) for e in retired]}
+
+    # -- surviving-mesh re-anchor (ISSUE 16) ----------------------------------
+
+    def reanchor(self, mesh, *, why: str = "device loss") -> dict:
+        """Hot-swap every live engine onto ``mesh`` — the surviving-mesh
+        re-anchor after device loss or per-chip admission denial.
+
+        Zero request loss, the PR-12 swap invariant: each replacement
+        engine is built and REGISTERED before its predecessor is unrouted,
+        so requests route to one or the other at every instant; the
+        predecessor then drains (every outstanding future resolves) and
+        closes through the same :meth:`_retire_entry` path a mix-driven
+        retire uses.  A shape whose rebuild fails on every tier keeps its
+        OLD engine serving (degraded, not dead) and lands in the record's
+        ``failed`` list.  The whole event is counted ``mesh_reanchor``
+        (trace fault instant + flight-recorder postmortem) and the record
+        is surfaced as ``last_reanchor`` in :meth:`record`.
+        """
+        t0 = time.perf_counter()
+        if self._factory is None:
+            raise ServingUnavailable(
+                f"router {self.label}: cannot re-anchor without an engine "
+                "factory"
+            )
+        if isinstance(self._factory, MeshEngineFactory):
+            self._factory.set_mesh(mesh)
+        with self._lock:
+            if self._closed:
+                raise ServingUnavailable("router is closed")
+            self._mesh = mesh
+            old_entries = list(self._engines.values())
+        desc = kmesh.mesh_desc(mesh) if mesh is not None else "single-device"
+        swapped: list[dict] = []
+        failed: list[dict] = []
+        for old in old_entries:
+            try:
+                with trace.span(
+                    "router.reanchor", cat="serve", shape=list(old.key),
+                    label=self.label, mesh=desc,
+                ):
+                    engine = self._factory(old.key, old.engine.example_dtype)
+            except ServeError as e:
+                failed.append({
+                    "shape": list(old.key),
+                    "error": f"{type(e).__name__}: {e}",
+                })
+                _logger.warning(
+                    "router %s: re-anchor of shape %s onto mesh %s failed "
+                    "(%s) — old engine keeps serving",
+                    self.label, old.key, desc, e,
+                )
+                continue
+            if engine.label == old.engine.label:
+                # SLO trackers and drift monitors unregister BY LABEL when
+                # the predecessor retires — the replacement must not share
+                # its name or it gets unregistered with the corpse.
+                engine.label = f"{old.engine.label}@{desc}"
+            server = Server(engine, config=self._server_config)
+            now = self._clock()
+            with self._lock:
+                stale = self._closed or self._engines.get(old.key) is not old
+                if not stale:
+                    entry = _Entry(old.key, engine, server, now)
+                    entry.routes = old.routes
+                    entry.last_routed = old.last_routed
+                    self._engines[old.key] = entry
+            if stale:
+                # Retired/replaced mid-build (or the router closed) — do
+                # not resurrect the shape; discard the fresh server.
+                server.close()
+                server.join()
+                telemetry.unregister_slo(engine.label)
+                knum.unregister_drift(engine.label)
+                continue
+            trace.instant(
+                "router_engine_added", shape=list(old.key),
+                label=engine.label, mesh=desc,
+            )
+            self._retire_entry(
+                old, why=f"re-anchored onto mesh {desc} ({why})"
+            )
+            swapped.append({"shape": list(old.key), "label": engine.label})
+        wall = time.perf_counter() - t0
+        rec = {
+            "mesh": desc,
+            "why": why,
+            "swapped": swapped,
+            "failed": failed,
+            "reshard_wall_s": round(wall, 6),
+        }
+        with self._lock:
+            self._last_reanchor = rec
+        counters.record(
+            "mesh_reanchor",
+            f"router {self.label}: {len(swapped)} engine(s) re-anchored "
+            f"onto mesh {desc} in {wall:.3f}s ({why}; "
+            f"{len(failed)} failed)",
+        )
+        trace.instant(
+            "router_reanchor", mesh=desc, swapped=len(swapped),
+            failed=len(failed), wall_s=round(wall, 6), why=why,
+        )
+        _logger.info(
+            "router %s: re-anchored %d engine(s) onto mesh %s in %.3fs "
+            "(%s; %d failed)",
+            self.label, len(swapped), desc, wall, why, len(failed),
+        )
+        return rec
 
     def _retire_entry(self, entry: _Entry, why: str) -> None:
         """Graceful engine retirement: the entry is ALREADY unrouted (new
@@ -719,12 +952,16 @@ class ShapeRouter:
             }
             stats = self.stats.record()
             admissions = list(self.admissions)
+            last_reanchor = self._last_reanchor
+            mesh = self._mesh
         out = {
             "label": self.label,
+            "mesh": kmesh.mesh_desc(mesh) if mesh is not None else None,
             "config": self.config.record(),
             "engines": engines,
             "stats": stats,
             "admissions": admissions,
+            "last_reanchor": last_reanchor,
         }
         from . import profiler as kprof
 

@@ -256,11 +256,17 @@ class ServingEngine:
         config: ServeConfig | None = None,
         label: str = "pipeline",
         warmup: bool = True,
+        mesh=None,
     ):
         import jax
 
         self._jax = jax
-        self._pipe = pipe
+        #: the eager parity/offline oracle always applies the ORIGINAL
+        #: object — mixing mesh-committed params into the eager apply
+        #: would let placement errors masquerade as parity failures.
+        self._oracle_pipe = pipe
+        self.mesh = mesh
+        self._pipe = self._mesh_place(pipe, mesh) if mesh is not None else pipe
         self.label = label
         self.config = config or ServeConfig.from_env()
         self.example_shape = tuple(int(d) for d in example.shape)
@@ -296,10 +302,68 @@ class ServingEngine:
 
     # -- construction ---------------------------------------------------------
 
+    def _mesh_place(self, pipe, mesh):
+        """Pin the fitted state onto the serving mesh.  A ``jax.Array``
+        leaf already resident on exactly this mesh's devices keeps its
+        SOLVE placement (a mesh fit serves from where it solved — no host
+        pull); every other array leaf is placed replicated
+        (``autoshard.spec_sharding``) so each bucket program sees
+        committed, mesh-consistent parameters."""
+        from . import autoshard
+
+        jax = self._jax
+        mesh_devs = set(mesh.devices.flat)
+
+        def place(leaf):
+            if isinstance(leaf, jax.Array):
+                try:
+                    if set(leaf.sharding.device_set) == mesh_devs:
+                        return leaf
+                except Exception:  # noqa: BLE001 — unknown sharding: re-place
+                    pass
+            elif not isinstance(leaf, (np.ndarray, np.generic)):
+                return leaf
+            arr = np.asarray(jax.device_get(leaf))
+            return jax.device_put(
+                arr, autoshard.spec_sharding("replicated", mesh, arr.ndim)
+            )
+
+        return jax.tree_util.tree_map(place, pipe)
+
+    def _batch_sharding(self, bucket: int):
+        """Layout of one request micro-batch on the serving mesh:
+        row-sharded over the data axis when the bucket divides evenly,
+        replicated otherwise (small buckets under a wide mesh).  ``None``
+        when the engine is meshless."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS
+
+        d = int(self.mesh.shape[DATA_AXIS])
+        if d > 1 and bucket % d == 0:
+            return NamedSharding(self.mesh, P(DATA_AXIS))
+        return NamedSharding(self.mesh, P())
+
     def _batch_struct(self, bucket: int):
+        sharding = self._batch_sharding(bucket)
+        if sharding is not None:
+            return self._jax.ShapeDtypeStruct(
+                (bucket, *self.example_shape), self.example_dtype,
+                sharding=sharding,
+            )
         return self._jax.ShapeDtypeStruct(
             (bucket, *self.example_shape), self.example_dtype
         )
+
+    def _h2d(self, padded: np.ndarray, bucket: int):
+        """One micro-batch host->device, onto the serving mesh when one is
+        set — the layout the bucket's AOT executable was lowered for."""
+        sharding = self._batch_sharding(bucket)
+        if sharding is None:
+            return self._jax.device_put(padded)
+        return self._jax.device_put(padded, sharding)
 
     def _build(self) -> None:
         for i, bucket in enumerate(self.config.buckets):
@@ -313,6 +377,7 @@ class ServingEngine:
                     self._batch_struct(bucket),
                     label=f"serve:{self.label}:b{bucket}",
                     require_analysis=True,
+                    mesh=self.mesh,
                 )
             self.memory_plans[bucket] = plan
             if plan.compiled is None:
@@ -380,7 +445,7 @@ class ServingEngine:
             ):
                 outs[bucket] = np.asarray(
                     self._execute(
-                        bucket, self._jax.device_put(probe[:bucket])
+                        bucket, self._h2d(probe[:bucket], bucket)
                     )
                 )
             dt = time.perf_counter() - t0
@@ -541,7 +606,7 @@ class ServingEngine:
         with trace.io_span(
             "serve.h2d", padded.nbytes, cat="serve", bucket=bucket
         ):
-            dev = self._jax.device_put(padded)
+            dev = self._h2d(padded, bucket)
         try:
             t_exec = time.perf_counter()
             with trace.span(
@@ -590,15 +655,18 @@ class ServingEngine:
         asserted bit-equal against."""
         import jax.numpy as jnp
 
-        return np.asarray(self._pipe(jnp.asarray(host_batch)))
+        return np.asarray(self._oracle_pipe(jnp.asarray(host_batch)))
 
     def record(self) -> dict:
         """JSON-able engine summary for bench records."""
+        from ..parallel.mesh import mesh_desc
+
         return {
             "label": self.label,
             "config": self.config.record(),
             "example_shape": list(self.example_shape),
             "example_dtype": str(self.example_dtype),
+            "mesh": mesh_desc(self.mesh) if self.mesh is not None else None,
             "live_buckets": list(self.buckets()),
             "parity_ok": self.parity_ok,
             "parity": {str(k): v for k, v in self.parity.items()},
@@ -621,24 +689,28 @@ def load_engine(
     config: ServeConfig | None = None,
     label: str = "pipeline",
     wrap: Callable[[Any], Any] | None = None,
+    mesh=None,
 ) -> tuple[ServingEngine, dict]:
     """Warm-load a fitted pipeline from a ``core.checkpoint`` artifact and
     stand up its serving engine, measuring the fresh-process COLD START:
     restore seconds, per-bucket AOT compile (inside engine build), and the
     warmup inference.  ``wrap`` post-processes the loaded object into the
     servable Transformer (e.g. a workload assembling a checkpointed dict
-    of fitted nodes into its apply chain).  Returns
-    ``(engine, cold_start_record)``."""
+    of fitted nodes into its apply chain).  ``mesh`` makes the whole round
+    trip topology-portable: the checkpoint restores THROUGH
+    ``load_pipeline(mesh=)`` (resharded onto the target, even when it was
+    recorded under a different topology) and the engine AOT-compiles
+    mesh-native on it.  Returns ``(engine, cold_start_record)``."""
     from .checkpoint import load_numerics_baseline, load_pipeline
 
     t0 = time.perf_counter()
     with trace.span("serve.cold_load", cat="serve", path=path):
-        pipe = load_pipeline(path)
+        pipe = load_pipeline(path, mesh=mesh)
     t_load = time.perf_counter()
     if wrap is not None:
         pipe = wrap(pipe)
     engine = ServingEngine(
-        pipe, example, config=config, label=label, warmup=False
+        pipe, example, config=config, label=label, warmup=False, mesh=mesh
     )
     # Output-drift detection (ISSUE 15): arm the monitor from the
     # fit-time reference sketch the checkpoint manifest carries (absent
@@ -653,6 +725,10 @@ def load_engine(
         "warmup_seconds": round(t_warm - t_build, 4),
         "cold_start_seconds": round(t_warm - t0, 4),
     }
+    if mesh is not None:
+        from ..parallel.mesh import mesh_desc
+
+        cold["mesh"] = mesh_desc(mesh)
     trace.instant("serve_cold_start", label=label, **cold)
     return engine, cold
 
@@ -946,7 +1022,7 @@ class Server:
                         req_first=futs[0].request_id,
                         req_last=futs[-1].request_id,
                     ):
-                        dev = self.engine._jax.device_put(padded)
+                        dev = self.engine._h2d(padded, bucket)
                     t_h2d_done = time.perf_counter()
                     entry = (futs, rows, dev, bucket, t_assembled, t_h2d_done)
                     with self._inflight_cond:

@@ -90,6 +90,11 @@ POSTMORTEM_KINDS = frozenset(
         # opens the bounded xprof window the ISSUE asks for).
         "numerics_nonfinite",
         "serve_output_drift",
+        # Elastic serving (ISSUE 16): a surviving-mesh re-anchor is a
+        # topology-loss event — the postmortem captures which engines were
+        # hot-swapped, the mesh they landed on, and the in-flight counters
+        # at the moment the substrate shrank.
+        "mesh_reanchor",
     }
 )
 

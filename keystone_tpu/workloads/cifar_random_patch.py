@@ -141,6 +141,9 @@ class RandomCifarConfig:
     serve_bench: bool = False
     serve_clients: int = 4
     serve_requests: int = 256
+    #: ``--serveMesh DxM``: serve on an explicit mesh — the checkpoint
+    #: reshards onto it and buckets AOT-compile mesh-native (ISSUE 16).
+    serve_mesh: str | None = None
 
 
 class _Log(Logging):
@@ -678,6 +681,7 @@ def _maybe_serve(conf: RandomCifarConfig, test, results: dict, log) -> None:
         label="random_patch_cifar",
         bench=conf.serve_bench,
         clients=conf.serve_clients,
+        mesh=serve_common.resolve_serve_mesh(conf.serve_mesh),
     )
 
 
@@ -830,6 +834,7 @@ def main(argv=None):
         serve_bench=a.serveBench,
         serve_clients=a.serveClients,
         serve_requests=a.serveRequests,
+        serve_mesh=a.serveMesh,
     )
     if a.testLocation is None and a.streamTestTar is None:
         p.error("one of --testLocation / --streamTestTar is required")

@@ -87,6 +87,9 @@ class MnistRandomFFTConfig:
     serve_bench: bool = False
     serve_clients: int = 4
     serve_requests: int = 256
+    #: ``--serveMesh DxM``: serve on an explicit mesh — the checkpoint
+    #: reshards onto it and buckets AOT-compile mesh-native (ISSUE 16).
+    serve_mesh: str | None = None
 
 
 def build_featurizer_batches(conf: MnistRandomFFTConfig):
@@ -318,6 +321,7 @@ def _maybe_serve(conf: MnistRandomFFTConfig, test, results: dict, log) -> None:
         label="mnist_random_fft",
         bench=conf.serve_bench,
         clients=conf.serve_clients,
+        mesh=serve_common.resolve_serve_mesh(conf.serve_mesh),
     )
 
 
@@ -403,6 +407,7 @@ def main(argv=None):
         serve_bench=a.serveBench,
         serve_clients=a.serveClients,
         serve_requests=a.serveRequests,
+        serve_mesh=a.serveMesh,
     )
     if (a.serve or a.serveBench) and not a.pipelineFile:
         p.error("--serve/--serveBench require --pipelineFile")

@@ -63,6 +63,40 @@ def add_serve_args(p) -> None:
         default=256,
         help="max requests drawn from the test split for --serve/--serveBench",
     )
+    p.add_argument(
+        "--serveMesh",
+        default=None,
+        metavar="DxM",
+        help="serve on an explicit device mesh, e.g. 2x1 — the checkpoint "
+        "reshards onto it (topology-portable restore, even when it was "
+        "recorded under a different topology) and every bucket "
+        "AOT-compiles mesh-native; devices are taken in jax.devices() "
+        "order",
+    )
+
+
+def resolve_serve_mesh(spec: str | None):
+    """``--serveMesh DxM`` -> a live ``Mesh`` over the first D*M local
+    devices (``None`` passes through — single-device serving unchanged)."""
+    if spec is None:
+        return None
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    try:
+        data, model = (int(s) for s in spec.lower().split("x"))
+    except ValueError:
+        raise ValueError(
+            f"--serveMesh {spec!r}: expected DxM (e.g. 2x1)"
+        ) from None
+    devs = jax.devices()
+    if data * model > len(devs):
+        raise ValueError(
+            f"--serveMesh {spec}: needs {data * model} devices but this "
+            f"process has {len(devs)}"
+        )
+    return make_mesh(data=data, model=model, devices=devs[: data * model])
 
 
 def serve_fitted(
@@ -75,28 +109,33 @@ def serve_fitted(
     bench: bool = False,
     clients: int = 4,
     timeout: float = 120.0,
+    mesh=None,
     log=None,
 ) -> dict:
     """Warm-load the fitted pipeline and serve ``requests`` through the
     online path; returns the JSON-able serving record (cold start + engine
-    summary + either the smoke answers or the full SLO bench)."""
+    summary + either the smoke answers or the full SLO bench).  ``mesh``
+    (from ``--serveMesh``) makes the endpoint topology-portable: the
+    checkpoint restores through ``load_pipeline(mesh=)`` resharding and
+    the engine AOT-compiles mesh-native (ISSUE 16)."""
     from ..core import serve as kserve
 
     lg = log or _logger
     requests = np.asarray(requests)
     engine, cold = kserve.load_engine(
-        pipeline_file, example, label=label, wrap=wrap
+        pipeline_file, example, label=label, wrap=wrap, mesh=mesh
     )
     record: dict = {"cold_start": cold}
     lg.info(
         "%s: serving cold start %.3fs (restore %.3fs, compile %.3fs, "
-        "warmup %.3fs); live buckets %s",
+        "warmup %.3fs); live buckets %s%s",
         label,
         cold["cold_start_seconds"],
         cold["checkpoint_load_seconds"],
         cold["compile_seconds"],
         cold["warmup_seconds"],
         list(engine.buckets()),
+        f"; mesh {cold['mesh']}" if mesh is not None else "",
     )
     if bench:
         record["bench"] = kserve.serve_bench(

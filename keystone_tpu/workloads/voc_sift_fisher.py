@@ -160,6 +160,9 @@ class SIFTFisherConfig:
     serve_bench: bool = False
     serve_clients: int = 4
     serve_requests: int = 64
+    #: ``--serveMesh DxM``: serve on an explicit mesh — the checkpoint
+    #: reshards onto it and buckets AOT-compile mesh-native (ISSUE 16).
+    serve_mesh: str | None = None
 
 
 class _Log(Logging):
@@ -455,6 +458,7 @@ def _maybe_serve(conf: SIFTFisherConfig, test, results: dict, log) -> None:
         wrap=lambda bundle: servable_pipeline(conf, bundle),
         bench=conf.serve_bench,
         clients=conf.serve_clients,
+        mesh=serve_common.resolve_serve_mesh(conf.serve_mesh),
     )
     record["request_shape"] = list(requests.shape[1:])
     record["shape_buckets_total"] = len(buckets)
@@ -590,6 +594,7 @@ def main(argv=None):
         serve_bench=a.serveBench,
         serve_clients=a.serveClients,
         serve_requests=a.serveRequests,
+        serve_mesh=a.serveMesh,
     )
     if conf.pipeline_file is not None and checkpoint_exists(conf.pipeline_file):
         # Restored runs never touch training data — skip decoding the

@@ -108,6 +108,15 @@ draws its parameters — fully deterministic):
   flight-recorder postmortem dumped, and every answer must stay
   bit-equal to an UNMONITORED engine serving the same mix — detection
   fires loudly, the answers never change.
+* ``mesh_shrink`` — device loss mid-serve (ISSUE 16): a mesh-anchored
+  router's engines are re-anchored onto the SURVIVING mesh while requests
+  are in flight — every one answered bit-equal to the offline apply
+  (zero request loss across the hot swap), the event counted
+  ``mesh_reanchor`` — and a fit checkpointed SHARDED under the full mesh
+  must refuse a naive load (typed ``CheckpointMismatch`` naming the
+  ``mesh=`` reshard path) then resume onto the survivors via
+  ``load_pipeline(mesh=)`` with predictions bit-equal to the fault-free
+  full-mesh run.
 """
 
 from __future__ import annotations
@@ -171,6 +180,7 @@ FAMILIES = (
     "jpeg_corrupt_entropy",
     "profiler_crash",
     "output_drift",
+    "mesh_shrink",
 )
 
 #: The serving-path families (core.serve / core.frontend / core.wire),
@@ -186,8 +196,8 @@ SERVE_FAMILIES = (
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(22))
-FULL_SEEDS = tuple(range(44))
+TIER1_SEEDS = tuple(range(23))
+FULL_SEEDS = tuple(range(46))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -369,6 +379,16 @@ def make_schedule(seed: int) -> Fault:
                 # monitor is allowed to judge the shifted mix.
                 "shifted": int(rng.integers(48, 81)),
                 "shift_scale": float(rng.uniform(4.0, 8.0)),
+            },
+        )
+    if kind == "mesh_shrink":
+        return Fault(
+            kind,
+            {
+                "requests": int(rng.integers(6, 13)),
+                # how much of the 4-device full mesh survives the loss
+                "survivors": int(rng.integers(1, 3)),
+                "hold_seconds": 0.25,
             },
         )
     return Fault("deadline", {"seconds": 1.0})
@@ -1503,6 +1523,163 @@ def _output_drift_phase(fault: Fault, tmpdir: str, seed: int) -> None:
         )
 
 
+def _mesh_shrink_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """Device loss mid-serve (ISSUE 16), both halves of the elastic story.
+
+    Leg 1 (live re-anchor): a router anchored on a 4-device mesh has
+    requests IN FLIGHT (the engine's execute is stretched so the loss
+    demonstrably straddles live batches) when the mesh shrinks to the
+    schedule's survivor count; ``reanchor`` must hot-swap every engine
+    onto the surviving mesh with every future resolving bit-equal to the
+    offline apply — zero request loss — and the event counted
+    ``mesh_reanchor``.
+
+    Leg 2 (reshard-resume): fitted state saved SHARDED under the full
+    mesh must refuse a naive load with a typed ``CheckpointMismatch``
+    that names the ``mesh=`` escape hatch, then resume onto the surviving
+    mesh via ``load_pipeline(mesh=)`` (counted ``ckpt_reshard``) with
+    predictions bit-equal to the fault-free full-mesh run.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from keystone_tpu.core import frontend as kfrontend
+    from keystone_tpu.core import serve as kserve
+    from keystone_tpu.core.checkpoint import (
+        CheckpointMismatch,
+        load_pipeline,
+        save_pipeline,
+    )
+    from keystone_tpu.core.pipeline import FunctionTransformer
+    from keystone_tpu.ops.stats import StandardScalerModel
+    from keystone_tpu.parallel.mesh import DATA_AXIS, make_mesh, use_mesh
+
+    rng = np.random.default_rng(seed)
+    n = int(fault.params["requests"])
+    survivors = int(fault.params["survivors"])
+    hold = float(fault.params["hold_seconds"])
+    devs = jax.devices()
+    # The tier-1 substrate has 8 virtual devices (full = 4x1); a
+    # standalone single-device chaos_run still exercises the swap
+    # machinery on whatever mesh the host actually has.
+    n_full = min(4, len(devs))
+    survivors = min(survivors, n_full)
+    full = make_mesh(data=n_full, model=1, devices=devs[:n_full])
+    surviving = make_mesh(data=survivors, model=1, devices=devs[:survivors])
+
+    # -- leg 1: live re-anchor with requests in flight ------------------------
+    wrng = np.random.default_rng(_DATA_SEED)
+    w = jnp.asarray(wrng.normal(size=(16,)).astype(np.float32))
+    b = jnp.asarray(wrng.normal(size=(16,)).astype(np.float32))
+    # Fusion-invariant arithmetic (see _serve_engine): eager == jit ==
+    # every bucket on every mesh tier, so the bit-equality oracle tests
+    # the SWAP, not XLA's rounding moods.
+    pipe = FunctionTransformer(
+        lambda x: jnp.maximum(x * w, b), name="chaos_mesh_shrink"
+    )
+
+    def build(shape, dtype, mesh):
+        cfg = kserve.ServeConfig(buckets=(1, 2, 4), max_wait_ms=2.0)
+        return kserve.ServingEngine(
+            pipe, np.zeros(shape, dtype), config=cfg,
+            label=f"chaos_shrink_{seed}", mesh=mesh,
+        )
+
+    reqs = _serve_requests(rng, 2 * n)
+    factory = kfrontend.MeshEngineFactory(build, mesh=full)
+    router = kfrontend.ShapeRouter(
+        factory, label=f"chaos_shrink_{seed}",
+        config=kfrontend.RouterConfig(warm_threshold=1, retire_after_s=300.0),
+    )
+    before = counters.get("mesh_reanchor")
+    try:
+        engine = factory((16,), np.float32)
+        router.add_engine(engine)
+        offline = np.asarray(engine.offline(reqs))
+        real_execute = engine._execute
+
+        def slow_execute(bucket, dev_batch):
+            # Stretch the doomed mesh's batches so the loss demonstrably
+            # lands while requests are IN FLIGHT, not between them.
+            time.sleep(hold)
+            return real_execute(bucket, dev_batch)
+
+        engine._execute = slow_execute
+        try:
+            futs = [router.submit(r) for r in reqs[:n]]
+            rec = router.reanchor(
+                surviving, why=f"chaos seed {seed}: device loss"
+            )
+        finally:
+            engine._execute = real_execute
+        futs += [router.submit(r) for r in reqs[n:]]
+        answers = np.stack([np.asarray(f.result(60.0)) for f in futs])
+    finally:
+        router.close()
+    if rec["failed"]:
+        raise ChaosOracleError(
+            f"re-anchor left shapes on the dead mesh: {rec['failed']}"
+        )
+    if counters.get("mesh_reanchor") - before < 1:
+        raise ChaosOracleError(
+            "engines re-anchored onto the surviving mesh but no "
+            "mesh_reanchor was counted"
+        )
+    if not np.array_equal(answers, offline):
+        raise ChaosOracleError(
+            "answers across the re-anchor differ from the offline apply — "
+            "the surviving mesh changed RESULTS, not just placement"
+        )
+
+    # -- leg 2: checkpoint on mesh A, resume on surviving mesh B --------------
+    mean = jax.device_put(
+        jnp.asarray(wrng.normal(size=(16,)).astype(np.float32)),
+        NamedSharding(full, PartitionSpec(DATA_AXIS)),
+    )
+    std = jnp.abs(jnp.asarray(wrng.normal(size=(16,)).astype(np.float32))) + 1.0
+    scaler = StandardScalerModel(mean, std)
+    test_rows = _serve_requests(rng, n)
+    fault_free = np.asarray(
+        StandardScalerModel(np.asarray(jax.device_get(mean)), np.asarray(std))(
+            test_rows
+        )
+    )
+    stem = os.path.join(tmpdir, f"chaos_shrink_{seed}_ckpt")
+    with use_mesh(full):
+        stem = save_pipeline(stem, scaler)
+    if n_full >= 2:
+        # Arrays sharded over >1 device: the naive load must REFUSE typed.
+        # (On a 1-device host the state is effectively replicated and the
+        # strict load legitimately succeeds — nothing to refuse.)
+        try:
+            load_pipeline(stem)
+        except CheckpointMismatch as e:
+            if "mesh=" not in str(e):
+                raise ChaosOracleError(
+                    f"the topology refusal does not name the mesh= reshard "
+                    f"path: {e}"
+                )
+        else:
+            raise ChaosOracleError(
+                "a checkpoint holding full-mesh-sharded arrays loaded "
+                "silently onto a different topology"
+            )
+    before_rs = counters.get("ckpt_reshard")
+    resumed = load_pipeline(stem, mesh=surviving)
+    if counters.get("ckpt_reshard") - before_rs < 1:
+        raise ChaosOracleError(
+            "the checkpoint resumed on the surviving mesh but no "
+            "ckpt_reshard was counted"
+        )
+    got = np.asarray(resumed(jnp.asarray(test_rows)))
+    if not np.array_equal(got, fault_free):
+        raise ChaosOracleError(
+            "predictions resumed on the surviving mesh differ from the "
+            "fault-free full-mesh run"
+        )
+
+
 def _stepdown_oracle(
     res: dict,
     stepdown_delta: int,
@@ -1588,6 +1765,10 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "output_drift":
         _output_drift_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "mesh_shrink":
+        _mesh_shrink_phase(fault, tmpdir, seed)
         return _run_workload(workload)
 
     if fault.kind == "stream_hang":

@@ -38,6 +38,7 @@ EXPECTED_COUNTER = {
     "jpeg_corrupt_entropy": "jpeg_corrupt_entropy",
     "profiler_crash": "profiler_sampler_crash",
     "output_drift": "serve_output_drift",
+    "mesh_shrink": "mesh_reanchor",
 }
 
 
@@ -61,7 +62,7 @@ def test_chaos_schedule_mnist(seed, tmp_path):
     trace_path = str(tmp_path / f"chaos_seed{seed}.json")
     r = chaos.run_schedule(
         seed, "mnist", tmpdir=str(tmp_path), trace_path=trace_path
-    )  # 22 families as of ISSUE 15 (output_drift)
+    )  # 23 families as of ISSUE 16 (mesh_shrink)
     _check(r)
     violations = chaos.verify_trace(trace_path, r)
     assert violations == [], {
@@ -121,6 +122,12 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # serve_output_drift with a postmortem, every answer bit-equal to an
     # unmonitored engine
     assert "output_drift" in kinds
+    # Elastic-serving coverage (ISSUE 16): device loss mid-serve must
+    # re-anchor every engine onto the surviving mesh with zero request
+    # loss (counted mesh_reanchor), and a full-mesh-sharded checkpoint
+    # must resume onto the survivors predictions-equal — never a silent
+    # divergence, never a crash for a mesh the process still has
+    assert "mesh_shrink" in kinds
 
 
 def test_schedules_are_deterministic():

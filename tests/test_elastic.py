@@ -1,0 +1,238 @@
+"""Elastic mesh serving (ISSUE 16) — the acceptance surface.
+
+A fit checkpointed under a 4-device mesh must RESUME and SERVE under a
+2-device and a 1-device mesh with predictions bit-equal to the
+original-mesh run (or a typed, counted refusal — never silent
+divergence); the naive load stays a typed ``CheckpointMismatch`` naming
+both topologies and the ``mesh=`` escape hatch; and the router's
+cross-engine HBM admission re-runs against the SURVIVING mesh's
+per-chip budget after a re-anchor.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from keystone_tpu.core import frontend as kfrontend
+from keystone_tpu.core import memory as kmem
+from keystone_tpu.core import serve as kserve
+from keystone_tpu.core.checkpoint import (
+    CheckpointMismatch,
+    load_pipeline,
+    save_pipeline,
+)
+from keystone_tpu.core.pipeline import FunctionTransformer
+from keystone_tpu.core.resilience import counters
+from keystone_tpu.ops.stats import StandardScaler, StandardScalerModel
+from keystone_tpu.parallel.mesh import (
+    DATA_AXIS,
+    make_mesh,
+    mesh_desc,
+    row_sharding,
+    use_mesh,
+)
+
+WIDTH = 16
+
+
+@pytest.fixture
+def full_mesh(devices):
+    return make_mesh(data=4, model=1, devices=devices[:4])
+
+
+def _fitted_stem(tmp_path, rng, full_mesh, name="elastic"):
+    """A fit under the 4-device mesh whose checkpoint holds SHARDED state:
+    the scaler is fitted on row-sharded data under ``use_mesh`` and its
+    mean is then anchored to the fit placement (data@dim0), so the
+    manifest records a real non-replicated spec the reshard loader must
+    redistribute."""
+    x = jnp.asarray(rng.normal(size=(32, WIDTH)), jnp.float32)
+    with use_mesh(full_mesh):
+        model = StandardScaler().fit(
+            jax.device_put(x, row_sharding(full_mesh))
+        )
+        model.mean = jax.device_put(
+            model.mean, NamedSharding(full_mesh, PartitionSpec(DATA_AXIS))
+        )
+        stem = save_pipeline(str(tmp_path / name), model)
+    test_rows = np.asarray(rng.normal(size=(12, WIDTH)), np.float32)
+    original = np.asarray(model(jnp.asarray(test_rows)))
+    return stem, test_rows, original
+
+
+class TestTopologyPortableCheckpoints:
+    @pytest.mark.parametrize("survivors", (2, 1))
+    def test_resume_and_serve_on_smaller_mesh_bit_equal(
+        self, tmp_path, rng, devices, full_mesh, survivors
+    ):
+        """The acceptance criterion: 4-device fit -> checkpoint ->
+        resume AND serve under the surviving mesh, predictions bit-equal
+        to the original-mesh run."""
+        stem, test_rows, original = _fitted_stem(tmp_path, rng, full_mesh)
+        target = make_mesh(data=survivors, model=1, devices=devices[:survivors])
+
+        before = counters.get("ckpt_reshard")
+        resumed = load_pipeline(stem, mesh=target)
+        assert counters.get("ckpt_reshard") - before >= 1
+        np.testing.assert_array_equal(
+            np.asarray(resumed(jnp.asarray(test_rows))), original
+        )
+
+        engine, cold = kserve.load_engine(
+            stem, np.zeros(WIDTH, np.float32),
+            config=kserve.ServeConfig(buckets=(1, 2, 4), max_wait_ms=2.0),
+            label=f"elastic_{survivors}", mesh=target,
+        )
+        assert cold["mesh"] == mesh_desc(target)
+        assert engine.parity_ok, engine.parity
+        np.testing.assert_array_equal(engine.infer(test_rows), original)
+        with kserve.Server(engine) as server:
+            served = np.stack([
+                f.result(30.0) for f in [server.submit(r) for r in test_rows]
+            ])
+        np.testing.assert_array_equal(served, original)
+
+    def test_naive_load_refuses_typed_naming_both_topologies(
+        self, tmp_path, rng, full_mesh
+    ):
+        """CheckpointMismatch ergonomics: the refusal names the recorded
+        AND the current topology and points at the mesh= reshard path."""
+        stem, _, _ = _fitted_stem(tmp_path, rng, full_mesh, name="refuse")
+        with pytest.raises(CheckpointMismatch) as exc:
+            load_pipeline(stem)
+        msg = str(exc.value)
+        assert "'data': 4" in msg  # the recorded (fit-time) topology
+        assert "mesh=" in msg  # the escape hatch, by name
+        assert "refusing" in msg
+
+    def test_manifest_records_per_array_sharding_specs(
+        self, tmp_path, rng, full_mesh
+    ):
+        stem, _, _ = _fitted_stem(tmp_path, rng, full_mesh, name="manifest")
+        with open(stem + ".json") as fh:
+            manifest = json.load(fh)
+        specs = [
+            spec.get("sharding", "replicated")
+            for spec in manifest["arrays"].values()
+        ]
+        assert "data@dim0" in specs  # the mean's fit placement
+        assert manifest["all_replicated"] is False
+
+    def test_reshard_disabled_stays_the_default(self, tmp_path, rng, full_mesh):
+        """mesh=None keeps the typed refusal — resharding is opt-in, a
+        surprise topology never silently redistributes."""
+        stem, _, _ = _fitted_stem(tmp_path, rng, full_mesh, name="optin")
+        with pytest.raises(CheckpointMismatch):
+            load_pipeline(stem, mesh=None)
+
+
+def _relu_build():
+    # Shape-agnostic, fusion-invariant arithmetic (one exactly-rounded
+    # multiply + max): eager == jit == every bucket on every mesh tier,
+    # and any request width builds.
+    pipe = FunctionTransformer(
+        lambda x: jnp.maximum(x * 1.5, 0.25), name="elastic"
+    )
+
+    def build(shape, dtype, mesh):
+        cfg = kserve.ServeConfig(buckets=(1, 2, 4), max_wait_ms=2.0)
+        return kserve.ServingEngine(
+            pipe, np.zeros(shape, dtype), config=cfg, label="elastic",
+            mesh=mesh,
+        )
+
+    return build
+
+
+class TestSurvivingMeshReanchor:
+    def test_reanchor_swaps_labels_and_keeps_answers(
+        self, devices, full_mesh, rng
+    ):
+        surviving = make_mesh(data=2, model=1, devices=devices[:2])
+        factory = kfrontend.MeshEngineFactory(_relu_build(), mesh=full_mesh)
+        router = kfrontend.ShapeRouter(
+            factory, label="elastic_swap",
+            config=kfrontend.RouterConfig(
+                warm_threshold=1, retire_after_s=300.0
+            ),
+        )
+        try:
+            engine = factory((WIDTH,), np.float32)
+            router.add_engine(engine)
+            reqs = np.asarray(rng.normal(size=(8, WIDTH)), np.float32)
+            expect = np.asarray(engine.offline(reqs))
+            futs = [router.submit(r) for r in reqs[:4]]
+            rec = router.reanchor(surviving, why="test shrink")
+            futs += [router.submit(r) for r in reqs[4:]]
+            got = np.stack([np.asarray(f.result(30.0)) for f in futs])
+            np.testing.assert_array_equal(got, expect)
+            assert rec["failed"] == [] and len(rec["swapped"]) == 1
+            # the replacement must NOT share the retired engine's label —
+            # SLO/drift trackers unregister by label at retire
+            assert router.engines()[(WIDTH,)] == f"elastic@{mesh_desc(surviving)}"
+            r = router.record()
+            assert r["mesh"] == mesh_desc(surviving)
+            assert r["last_reanchor"]["reshard_wall_s"] > 0
+        finally:
+            router.close()
+
+    def test_factory_walks_the_ladder_on_denial(self, devices, monkeypatch):
+        """A mesh tier whose buckets are all denied per-chip admission
+        steps down (counted router_mesh_stepdown) until a tier builds —
+        the single-device floor if need be."""
+        mesh = make_mesh(data=2, model=1, devices=devices[:2])
+        # Per-chip budget of 1 byte on ANY mesh: every mesh-tier bucket is
+        # denied; the meshless floor plans against hbm_budget (None here,
+        # analytic admission skipped) and builds.
+        monkeypatch.setattr(kmem, "min_chip_budget", lambda m: (1, None))
+        monkeypatch.setattr(kmem, "hbm_budget", lambda device=None: None)
+        before = counters.get("router_mesh_stepdown")
+        factory = kfrontend.MeshEngineFactory(_relu_build(), mesh=mesh)
+        engine = factory((WIDTH,), np.float32)
+        assert engine.mesh is None  # landed on the floor
+        assert counters.get("router_mesh_stepdown") - before >= 1
+
+    def test_cross_admission_pins_surviving_mesh_budget(
+        self, devices, full_mesh, monkeypatch
+    ):
+        """Satellite regression (ISSUE 16): after a re-anchor the router's
+        cross-engine admission must budget against the SURVIVING mesh's
+        min_chip_budget — the dead topology's (or the meshless global)
+        budget would over-admit."""
+        surviving = make_mesh(data=2, model=1, devices=devices[:2])
+        factory = kfrontend.MeshEngineFactory(_relu_build(), mesh=full_mesh)
+        router = kfrontend.ShapeRouter(
+            factory, label="elastic_admission",
+            config=kfrontend.RouterConfig(
+                warm_threshold=1, retire_after_s=300.0
+            ),
+        )
+        try:
+            router.add_engine(factory((WIDTH,), np.float32))
+            router.reanchor(surviving, why="test shrink")
+
+            seen = []
+
+            def spy_min_chip_budget(m):
+                seen.append(m)
+                return (64, None)  # tiny per-chip budget: must deny
+
+            monkeypatch.setattr(kmem, "min_chip_budget", spy_min_chip_budget)
+            # The WRONG budget source (the meshless global) would admit:
+            monkeypatch.setattr(kmem, "hbm_budget", lambda device=None: None)
+            with pytest.raises(kfrontend.RetryLater):
+                router.submit(np.zeros(8, np.float32))  # new shape -> warm
+            assert surviving in seen, (
+                "cross-engine admission never consulted the surviving "
+                "mesh's per-chip budget"
+            )
+            assert router.stats.admission_denied >= 1
+            denied = router.admissions[-1]
+            assert denied["admitted"] is False
+            assert denied["budget_bytes"] == 64
+        finally:
+            router.close()

@@ -1,0 +1,126 @@
+"""Degradation-ladder substrate coverage (ISSUE 16): ``reduced_mesh`` and
+the mesh enumerators over the DEGENERATE survivor shapes the elastic
+re-anchor walks — 1xN, Nx1, a single device, odd/prime device counts.
+The happy-path 8-device shapes were already exercised by the solver and
+autoshard suites; device loss hands these helpers whatever is left."""
+
+import pytest
+
+from keystone_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    enumerate_mesh_shapes,
+    enumerate_meshes,
+    make_mesh,
+    mesh_desc,
+    reduced_mesh,
+)
+
+
+def _survivors(devices, n):
+    assert len(devices) >= n, f"need {n} of the 8 virtual devices"
+    return devices[:n]
+
+
+class TestReducedMesh:
+    def test_collapses_model_axis_onto_data(self, devices):
+        mesh = make_mesh(data=2, model=2, devices=_survivors(devices, 4))
+        red = reduced_mesh(mesh)
+        assert mesh_desc(red) == "4x1"
+        # the SAME devices, every one of them — a ladder step trades
+        # layout, never capacity
+        assert list(red.devices.flat) == list(mesh.devices.flat)
+
+    def test_pure_data_mesh_has_no_rung_below(self, devices):
+        for n in (1, 2, 3, 8):
+            mesh = make_mesh(data=n, model=1, devices=_survivors(devices, n))
+            assert reduced_mesh(mesh) is None
+
+    def test_model_only_survivor_1xn(self, devices):
+        """1xN (a data-collapsed survivor that is ALL model axis) still
+        reduces to pure data-parallel over the same devices."""
+        mesh = make_mesh(data=1, model=4, devices=_survivors(devices, 4))
+        red = reduced_mesh(mesh)
+        assert mesh_desc(red) == "4x1"
+        assert list(red.devices.flat) == list(mesh.devices.flat)
+
+    def test_two_device_model_pair(self, devices):
+        mesh = make_mesh(data=1, model=2, devices=_survivors(devices, 2))
+        assert mesh_desc(reduced_mesh(mesh)) == "2x1"
+
+    def test_single_device_mesh_is_the_floor(self, devices):
+        mesh = make_mesh(data=1, model=1, devices=_survivors(devices, 1))
+        assert reduced_mesh(mesh) is None
+
+
+class TestEnumerateMeshShapes:
+    def test_single_device(self):
+        assert enumerate_mesh_shapes(1) == [(1, 1)]
+
+    @pytest.mark.parametrize("n", (3, 5, 7))
+    def test_prime_counts_yield_the_two_degenerates(self, n):
+        assert enumerate_mesh_shapes(n) == [(n, 1), (1, n)]
+
+    def test_odd_composite_count(self):
+        # 9 survivors of a 16-device pod: every divisor pair, data-major
+        assert enumerate_mesh_shapes(9) == [(9, 1), (3, 3), (1, 9)]
+
+    def test_data_major_descending_and_exhaustive(self):
+        shapes = enumerate_mesh_shapes(6)
+        assert shapes == [(6, 1), (3, 2), (2, 3), (1, 6)]
+        assert all(d * m == 6 for d, m in shapes)
+        datas = [d for d, _ in shapes]
+        assert datas == sorted(datas, reverse=True)
+
+    def test_zero_devices_refused(self):
+        with pytest.raises(ValueError, match=">= 1 device"):
+            enumerate_mesh_shapes(0)
+
+
+class TestEnumerateMeshes:
+    @pytest.mark.parametrize("n", (1, 3, 5, 7))
+    def test_degenerate_survivor_counts_materialize(self, devices, n):
+        """Odd/prime survivor sets — the shapes a device loss actually
+        leaves behind — must enumerate real, usable meshes."""
+        survivors = _survivors(devices, n)
+        meshes = enumerate_meshes(survivors)
+        assert [
+            (m.shape[DATA_AXIS], m.shape[MODEL_AXIS]) for m in meshes
+        ] == enumerate_mesh_shapes(n)
+        for m in meshes:
+            assert list(m.devices.flat) == list(survivors)
+
+    def test_deterministic_over_one_device_set(self, devices):
+        survivors = _survivors(devices, 5)
+        a = enumerate_meshes(survivors)
+        b = enumerate_meshes(survivors)
+        # memoized per device tuple: identical Mesh objects both times
+        # (searched-plan determinism), but a fresh mutable list per call
+        assert a == b
+        assert a is not b
+
+    def test_survivor_order_is_the_cache_key(self, devices):
+        fwd = _survivors(devices, 2)
+        rev = list(reversed(fwd))
+        a = enumerate_meshes(fwd)
+        b = enumerate_meshes(rev)
+        assert list(a[0].devices.flat) == fwd
+        assert list(b[0].devices.flat) == rev
+
+
+def test_ladder_walk_over_survivors(devices):
+    """The exact walk MeshEngineFactory takes: any survivor mesh steps
+    full -> reduced (same devices) -> None within two rungs; the floor is
+    always reachable."""
+    for n, model in ((4, 2), (2, 2), (3, 1), (1, 1)):
+        if model > 1:
+            mesh = make_mesh(
+                data=n // model, model=model, devices=_survivors(devices, n)
+            )
+        else:
+            mesh = make_mesh(data=n, model=1, devices=_survivors(devices, n))
+        rungs = 0
+        while mesh is not None:
+            mesh = reduced_mesh(mesh)
+            rungs += 1
+            assert rungs <= 2, "ladder failed to reach the floor"

@@ -121,6 +121,12 @@ DEFAULT_METRICS: tuple = (
         "extra_metrics.numerics.probe_overhead.probe_overhead_frac",
         "lower", 1.00,
     ),
+    # ISSUE 16: elastic serving — the checkpoint->foreign-mesh->serve
+    # reshard wall must not creep across rounds, and a live re-anchor
+    # must never drop a request (zero stays zero: any nonzero candidate
+    # against a zero base is a regression, see compare()).
+    ("extra_metrics.serving.reshard_wall_s", "lower", 0.50),
+    ("extra_metrics.serving.reanchor_dropped_requests", "lower", 0.00),
 )
 
 
@@ -170,14 +176,24 @@ def compare(
         if b is None or c is None:
             continue
         if b == 0:
-            continue  # a zero base makes the ratio meaningless
-        ratio = c / b
-        if direction == "higher":
-            regressed = ratio < 1.0 - threshold
-            improved = ratio > 1.0 + threshold
+            if direction == "lower":
+                # A zero base on a lower-is-better metric is a pin, not a
+                # meaningless ratio: dropped-request counts and their kin
+                # are REQUIRED to stay zero, so any nonzero candidate is a
+                # regression (ratio reported as the raw candidate value).
+                ratio = float(c)
+                regressed = c > 0
+                improved = False
+            else:
+                continue  # zero-base ratio on higher-is-better: no signal
         else:
-            regressed = ratio > 1.0 + threshold
-            improved = ratio < 1.0 - threshold
+            ratio = c / b
+            if direction == "higher":
+                regressed = ratio < 1.0 - threshold
+                improved = ratio > 1.0 + threshold
+            else:
+                regressed = ratio > 1.0 + threshold
+                improved = ratio < 1.0 - threshold
         status = (
             "regressed" if regressed else "improved" if improved else "ok"
         )

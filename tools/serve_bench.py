@@ -54,10 +54,11 @@ def parse_shapes(raw: str) -> list[tuple]:
     return [parse_shape(tok) for tok in raw.split(",") if tok.strip()]
 
 
-def toy_engine(shape: tuple, dtype=np.dtype(np.float32)):
+def toy_engine(shape: tuple, dtype=np.dtype(np.float32), mesh=None):
     """Deterministic per-shape engine (the chaos harness's
     fusion-invariant mul+max idiom: eager == jit == every bucket, so wire
-    answers are byte-verifiable)."""
+    answers are byte-verifiable).  ``mesh`` anchors the engine's buckets
+    on a device mesh (the elastic --kill-device drill)."""
     import jax.numpy as jnp
 
     from keystone_tpu.core import frontend, serve as kserve
@@ -73,6 +74,7 @@ def toy_engine(shape: tuple, dtype=np.dtype(np.float32)):
         np.zeros(shape, np.float32),
         config=cfg,
         label=frontend.shape_label("serve_bench", shape),
+        mesh=mesh,
     )
 
 
@@ -299,6 +301,14 @@ def main(argv=None) -> int:
                    help="with --wire: replay a shape-mix shift (warm add "
                    "+ retire over a live socket)")
     p.add_argument(
+        "--kill-device", type=int, default=None, metavar="N",
+        help="elastic drill (ISSUE 16): anchor the engines on a mesh over "
+        "every visible device, then mid-run 'lose' device N — the router "
+        "must re-anchor every engine onto the surviving mesh with zero "
+        "request loss; the record carries reshard_wall_s and "
+        "requests_in_flight_across_swap",
+    )
+    p.add_argument(
         "--numerics", action="store_true",
         help="turn the numerics observatory on for the run "
         "(KEYSTONE_NUMERICS equivalent): per-bucket output probes + drift "
@@ -319,19 +329,86 @@ def main(argv=None) -> int:
         "shapes": [list(s) for s in shapes],
         "requests_per_client": a.requests,
     }
+    clients = a.clients or (2 if a.wire else 4)
+    expected_requests = clients * a.requests
+
+    factory = toy_engine
+    surviving = None
+    if a.kill_device is not None:
+        import jax
+
+        from keystone_tpu.parallel.mesh import make_mesh, mesh_desc
+
+        devs = list(jax.devices())
+        if not 0 <= a.kill_device < len(devs):
+            p.error(
+                f"--kill-device {a.kill_device}: have {len(devs)} device(s)"
+            )
+        survivor_devs = [
+            d for i, d in enumerate(devs) if i != a.kill_device
+        ]
+        if not survivor_devs:
+            p.error("--kill-device would leave no surviving device")
+        full = make_mesh(data=len(devs), model=1, devices=devs)
+        surviving = make_mesh(
+            data=len(survivor_devs), model=1, devices=survivor_devs
+        )
+        factory = frontend.MeshEngineFactory(
+            lambda shape, dtype, mesh: toy_engine(shape, dtype, mesh=mesh),
+            mesh=full,
+        )
+        record["mesh"] = mesh_desc(full)
+
     t0 = time.perf_counter()
     router = frontend.ShapeRouter(
-        toy_engine, label="serve_bench", config=cfg
+        factory, label="serve_bench", config=cfg
     )
+    reshard_info: dict = {}
+
+    def _reanchor_drill():
+        # Wait for real traffic so the swap demonstrably lands with
+        # requests in flight, then lose the device.
+        from keystone_tpu.parallel.mesh import mesh_desc
+
+        end = time.monotonic() + a.timeout
+        target = max(1, expected_requests // 4)
+        while router.stats.routes < target and time.monotonic() < end:
+            time.sleep(0.005)
+        with router._lock:
+            entries = list(router._engines.values())
+        answered = sum(e.server.stats.answered for e in entries)
+        inflight = max(0, router.stats.routes - answered)
+        rec = router.reanchor(
+            surviving, why=f"--kill-device {a.kill_device}"
+        )
+        reshard_info.update(
+            killed_device=a.kill_device,
+            surviving_mesh=mesh_desc(surviving),
+            reshard_wall_s=rec["reshard_wall_s"],
+            requests_in_flight_across_swap=int(inflight),
+            swapped=len(rec["swapped"]),
+            failed=rec["failed"],
+        )
+
     ok = True
     numerics_ctx = knum.monitored(True) if a.numerics else contextlib.nullcontext()
     try:
         numerics_ctx.__enter__()
         for shape in shapes:
-            router.add_engine(toy_engine(shape))
+            engine = (
+                factory(shape, np.dtype(np.float32))
+                if a.kill_device is not None
+                else toy_engine(shape)
+            )
+            router.add_engine(engine)
         record["engine_build_seconds"] = round(time.perf_counter() - t0, 3)
+        drill = None
+        if a.kill_device is not None:
+            drill = threading.Thread(
+                target=_reanchor_drill, name="serve-bench-kill", daemon=True
+            )
+            drill.start()
         if a.wire:
-            clients = a.clients or 2
             with wire.WireServer(
                 router, port=a.port, label="serve_bench"
             ) as ws:
@@ -351,13 +428,28 @@ def main(argv=None) -> int:
                 ok = ok and "error" not in sh and sh["lost_requests"] == 0 \
                     and sh["warm_adds"] >= 1 and sh["retires"] >= 1
         else:
-            clients = a.clients or 4
             bench = run_inproc(
                 router, shapes, clients, a.requests, a.timeout
             )
             record["bench"] = bench
             ok = not bench["errors"] and bench["requests"] == (
                 clients * a.requests
+            )
+        if drill is not None:
+            drill.join(a.timeout)
+            dropped = expected_requests - bench["requests"]
+            reshard_info["reanchor_dropped_requests"] = int(dropped)
+            record["reshard"] = reshard_info
+            # Top-level copies for the regression observatory's dotted
+            # paths (tools/bench_diff.py): reshard wall must not creep,
+            # dropped requests must stay 0.
+            record["reshard_wall_s"] = reshard_info.get("reshard_wall_s")
+            record["reanchor_dropped_requests"] = int(dropped)
+            ok = (
+                ok
+                and "reshard_wall_s" in reshard_info
+                and not reshard_info.get("failed")
+                and dropped == 0
             )
         snap = trace.metrics.snapshot()
         overhead = snap["histograms"].get("router_route_overhead_us", {})
@@ -395,6 +487,15 @@ def main(argv=None) -> int:
             f"{b.get('clients')} client process(es), p99 "
             f"{b.get('wire_p99_ms')}ms, "
             f"{b.get('retry_after_total')} retry-after"
+        )
+    if record.get("reshard"):
+        rs = record["reshard"]
+        print(
+            f"# reshard: killed device {rs.get('killed_device')}, "
+            f"surviving mesh {rs.get('surviving_mesh')}, wall "
+            f"{rs.get('reshard_wall_s')}s, "
+            f"{rs.get('requests_in_flight_across_swap')} in flight across "
+            f"the swap, {rs.get('reanchor_dropped_requests')} dropped"
         )
     for err in b.get("errors", []):
         print(f"# ERROR {err}")
