@@ -2306,6 +2306,62 @@ def bench_profiler(rng):
     }
 
 
+def bench_multihost(rng):
+    """Multi-host elastic serving (ISSUE 17): the REAL 2-process
+    ``jax.distributed`` fit+serve (bit-identical to single-process on the
+    same shards, crosshost checkpoint reshard timed) and the host-loss
+    drill (SIGKILL one serving host mid-flight; survivors re-form,
+    reshard, re-anchor; zero request loss).  bench_diff regresses on
+    ``multihost.fit_serve_wall_s``, ``multihost.reshard_wall_s``, and
+    ``multihost.host_loss.reanchor_wall_s``, and pins
+    ``multihost.host_loss.dropped_requests`` at zero.  Where process
+    spawn is unavailable the section records zero-base rows and says so
+    — never a fake measurement."""
+    import shutil
+    import tempfile
+
+    from keystone_tpu.parallel.distributed import spawn_available
+    from keystone_tpu.workloads import multihost as mh
+
+    if not spawn_available():
+        return {
+            "available": False,
+            "fit_serve_wall_s": 0.0,
+            "reshard_wall_s": 0.0,
+            "host_loss": {"reanchor_wall_s": 0.0, "dropped_requests": 0},
+        }
+    tmp = tempfile.mkdtemp(prefix="bench_multihost_")
+    try:
+        fs = mh.run_two_process_fit_serve(
+            tmp, shards_per_host=2, images_per_shard=6, seed=0
+        )
+        drill = mh.run_host_loss_drill(
+            os.path.join(tmp, "drill"), hosts=2, requests=24, seed=0
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "available": True,
+        "fit_serve_wall_s": round(float(fs["fit_serve_wall_s"]), 3),
+        "reshard_wall_s": round(float(fs["reshard_wall_s"]), 4),
+        "bit_identical": fs["bit_identical"],
+        "crosshost_bit_equal": fs["crosshost_bit_equal"],
+        "n_images": fs["n_images"],
+        "leaked_threads": fs["leaked_threads"],
+        "host_loss": {
+            "mode": drill["mode"],
+            "hosts": drill["hosts"],
+            "reanchor_wall_s": round(
+                float(drill.get("reanchor_wall_s") or 0.0), 4
+            ),
+            "dropped_requests": int(drill["dropped_requests"]),
+            "mismatches": int(drill["mismatches"]),
+            "answered": int(drill["answered"]),
+            "postmortems": len(drill["postmortems"]),
+        },
+    }
+
+
 def bench_numerics(rng, serving: dict | None = None):
     """Numerics observatory (ISSUE 15): a laddered BCD fit runs MONITORED
     — the per-block κ table lands in ``FitReport.conditioning`` (the
@@ -2419,6 +2475,7 @@ def main():
     placement = _guarded(bench_placement, rng)
     profiler_sec = _guarded(bench_profiler, rng)
     numerics_sec = _guarded(lambda r: bench_numerics(r, serving), rng)
+    multihost_sec = _guarded(bench_multihost, rng)
     at_scale = _guarded(bench_solve_at_scale, rng)
 
     # ONE atomic registry snapshot feeds both the back-compat "faults" key
@@ -2522,6 +2579,12 @@ def main():
             # plus the serving probe-overhead rows (<= 5% p99 acceptance)
             # bench_diff regresses on.
             "numerics": numerics_sec,
+            # Multi-host elastic serving (parallel.distributed +
+            # workloads.multihost, ISSUE 17): real 2-process fit+serve
+            # bit-identity + crosshost reshard wall, and the host-loss
+            # drill's re-anchor wall with dropped_requests pinned at 0.
+            # Zero-base rows (available: false) where spawn is off.
+            "multihost": multihost_sec,
         },
     }
     # Regression observatory (ISSUE 11): this round judged against the
@@ -2706,6 +2769,22 @@ def main():
                 if smp.get("unavailable")
                 else f"{smp.get('samples', 0)} sample(s)"
             )
+        )
+    mhx = ex["multihost"]
+    if "error" in mhx:
+        print(f"# multihost: {mhx['error'][:120]}")
+    elif not mhx.get("available"):
+        print("# multihost: process spawn unavailable — zero-base rows")
+    else:
+        hl = mhx["host_loss"]
+        print(
+            f"# multihost: 2-process fit+serve "
+            f"{mhx['fit_serve_wall_s']}s (bit_identical "
+            f"{mhx['bit_identical']}, crosshost reshard "
+            f"{mhx['reshard_wall_s']}s), host-loss drill ({hl['mode']}) "
+            f"reanchor {hl['reanchor_wall_s']}s, "
+            f"{hl['dropped_requests']} dropped / {hl['mismatches']} "
+            f"mismatched of {hl['answered']}"
         )
     bd = record["bench_diff"]
     if "verdict" in bd:

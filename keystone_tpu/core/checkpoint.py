@@ -74,11 +74,17 @@ def _current_topology() -> dict:
 
     devs = jax.devices()
     mesh = current_mesh()
-    return {
+    topo = {
         "platform": devs[0].platform,
         "device_count": len(devs),
         "mesh": dict(mesh.shape) if mesh is not None else None,
     }
+    # Multi-process runs fingerprint their world size too (the key is
+    # omitted single-process so pre-ISSUE-17 checkpoints still compare
+    # equal under the topology guard).
+    if jax.process_count() > 1:
+        topo["processes"] = jax.process_count()
+    return topo
 
 
 def _is_replicated(v) -> bool:
@@ -381,12 +387,24 @@ class _Resharder:
     ``CheckpointError``, never an OOM mid-restore.  Arrays above
     ``KEYSTONE_RESHARD_CHUNK_BYTES`` transfer host-staged shard-by-shard via
     ``jax.make_array_from_callback`` so the transient footprint stays
-    bounded by one shard, not one whole array."""
+    bounded by one shard, not one whole array.
+
+    On a mesh spanning PROCESSES every placement goes through the
+    callback path unconditionally (counted ``ckpt_reshard_crosshost``):
+    ``make_array_from_callback`` materializes only the shards addressable
+    from each process, so every destination host pulls its own slices and
+    no single host stages the whole fleet's state — the cross-host
+    generalization of the chunked path, with per-host transient bounded
+    by that host's largest local shard.  (``device_put`` would refuse the
+    non-addressable devices outright; the single-process paths are kept
+    unchanged as the fallback.)"""
 
     def __init__(self, mesh, array_specs: dict, manifest_path: str):
         from . import memory as kmem
+        from ..parallel.mesh import mesh_spans_processes
 
         self.mesh = mesh
+        self.crosshost = mesh_spans_processes(mesh)
         self.mesh_shape = dict(mesh.shape)
         self.array_specs = array_specs
         self.manifest_path = manifest_path
@@ -399,7 +417,7 @@ class _Resharder:
         self.budget, _ = kmem.min_chip_budget(mesh)
         self.stats = {
             "arrays": 0, "resharded": 0, "host_staged": 0,
-            "spec_fallback": 0, "bytes": 0,
+            "spec_fallback": 0, "crosshost": 0, "bytes": 0,
         }
 
     def _target_spec(self, arr: np.ndarray, recorded: str) -> str:
@@ -458,6 +476,17 @@ class _Resharder:
         if spec != "replicated" or recorded != "replicated":
             self.stats["resharded"] += 1
         self.stats["bytes"] += int(arr.nbytes)
+        if self.crosshost:
+            # Destination-host pull: only the shards addressable from
+            # THIS process are materialized by the callback, so state is
+            # redistributed across the fleet without staging through one
+            # host's RAM.
+            self.stats["crosshost"] += 1
+            if arr.nbytes > self.chunk_bytes and arr.ndim:
+                self.stats["host_staged"] += 1
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: np.asarray(arr[idx])
+            )
         if arr.nbytes > self.chunk_bytes and arr.ndim:
             # Host-staged, per-shard transfer: each device receives only
             # its own slice, one shard in flight at a time.
@@ -636,6 +665,13 @@ def load_pipeline(path: str, mesh=None):
             f"[{st['resharded']} resharded, {st['host_staged']} "
             f"host-staged, {st['spec_fallback']} spec-fallback]",
         )
+        if st["crosshost"]:
+            counters.record(
+                "ckpt_reshard_crosshost",
+                f"{npz_path}: {st['crosshost']} array(s) pulled by "
+                f"destination hosts across a process-spanning mesh "
+                f"{mesh_desc(mesh)}",
+            )
         _logger.info(
             "loaded checkpoint %s resharded onto mesh %s (%d arrays, "
             "%d host-staged)",

@@ -972,3 +972,173 @@ class ShapeRouter:
             # serving artifact that embeds the router.
             out["profiler"] = kprof.ledger_record()
         return out
+
+
+# -- multi-host fleet front-end (ISSUE 17) ------------------------------------
+
+
+class HostFleet:
+    """The wire front-end over N HOST-LOCAL routers: one
+    :class:`~.wire.WireClient` per fleet member, requests spread
+    round-robin, and a member whose socket dies is declared lost (counted
+    ``fleet_host_lost``, postmortem-linked) with the request REISSUED to a
+    survivor — a host loss costs the fleet capacity, never an answer.
+
+    This is the serving half of the multi-host story: engines never span
+    hosts (``ServingEngine`` refuses a process-spanning mesh), so scale-out
+    is N independent ``ShapeRouter`` + ``WireServer`` pairs — one per host,
+    each anchored on its :func:`~..parallel.mesh.host_local_mesh` — fronted
+    by this class.  Predictions are pure, so reissuing an in-flight request
+    to a survivor is exact, not at-least-once-with-drift; a request only
+    fails when NO host is left (typed :class:`ServingUnavailable`).
+
+    Thread-safe: each member's client socket is guarded by its own lock, so
+    concurrent callers fan out across members instead of serializing."""
+
+    def __init__(self, endpoints, *, label: str = "fleet", timeout: float = 30.0):
+        if not endpoints:
+            raise ValueError("HostFleet needs at least one endpoint")
+        self.label = label
+        self.timeout = float(timeout)
+        self._hosts = []
+        for ep in endpoints:
+            if isinstance(ep, str):
+                host, _, port = ep.rpartition(":")
+                ep = (host or "127.0.0.1", int(port))
+            self._hosts.append(
+                {
+                    "endpoint": (str(ep[0]), int(ep[1])),
+                    "client": None,
+                    "lock": threading.Lock(),
+                    "alive": True,
+                    "requests": 0,
+                    "reissued": 0,
+                }
+            )
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self.lost_hosts = 0
+        trace.instant(
+            "fleet.up",
+            label=label,
+            hosts=[list(h["endpoint"]) for h in self._hosts],
+        )
+
+    def _client(self, h):
+        from . import wire
+
+        if h["client"] is None:
+            h["client"] = wire.WireClient(
+                h["endpoint"][0], h["endpoint"][1], timeout=self.timeout
+            )
+        return h["client"]
+
+    def _mark_lost(self, h, why: str) -> None:
+        if not h["alive"]:
+            return
+        h["alive"] = False
+        self.lost_hosts += 1
+        try:
+            if h["client"] is not None:
+                h["client"].close()
+        finally:
+            h["client"] = None
+        counters.record(
+            "fleet_host_lost", f"{self.label}: {h['endpoint']}: {why}"
+        )
+
+    def alive_hosts(self) -> list:
+        return [h["endpoint"] for h in self._hosts if h["alive"]]
+
+    def predict(self, arr, timeout: float | None = None):
+        """Answer one request through some live host.  A member that dies
+        mid-request (reset, closed socket, silence past the deadline) is
+        declared lost and the SAME request is reissued to the next member;
+        typed remote errors (the server answering "no") propagate — they
+        are answers, not host deaths."""
+        from . import wire
+
+        budget = timeout if timeout is not None else self.timeout
+        tried = 0
+        n = len(self._hosts)
+        while True:
+            live = [h for h in self._hosts if h["alive"]]
+            if not live:
+                raise ServingUnavailable(
+                    f"fleet {self.label!r}: all {n} host(s) lost"
+                )
+            with self._rr_lock:
+                h = live[self._rr % len(live)]
+                self._rr += 1
+            try:
+                with h["lock"]:
+                    client = self._client(h)
+                    h["requests"] += 1
+                    return client.predict(arr, timeout=budget)
+            except wire.WireRemoteError:
+                raise  # a typed answer from a live host
+            except (OSError, TimeoutError, wire.WireProtocolError) as e:
+                self._mark_lost(h, f"{type(e).__name__}: {e}")
+                tried += 1
+                if tried > n:  # pragma: no cover - every host died
+                    raise ServingUnavailable(
+                        f"fleet {self.label!r}: no host answered: {e}"
+                    ) from e
+                h["reissued"] += 1  # this member's loss forced a reissue
+
+    def reattach(self, endpoint) -> None:
+        """Re-admit a (restarted) member at ``endpoint`` — the scale-back-up
+        half of elasticity.  New endpoint, new member; known endpoint,
+        revived in place."""
+        if isinstance(endpoint, str):
+            host, _, port = endpoint.rpartition(":")
+            endpoint = (host or "127.0.0.1", int(port))
+        endpoint = (str(endpoint[0]), int(endpoint[1]))
+        for h in self._hosts:
+            if h["endpoint"] == endpoint:
+                h["alive"] = True
+                h["client"] = None
+                trace.instant("fleet.reattach", endpoint=list(endpoint))
+                return
+        self._hosts.append(
+            {
+                "endpoint": endpoint,
+                "client": None,
+                "lock": threading.Lock(),
+                "alive": True,
+                "requests": 0,
+                "reissued": 0,
+            }
+        )
+        trace.instant("fleet.reattach", endpoint=list(endpoint))
+
+    def record(self) -> dict:
+        return {
+            "label": self.label,
+            "hosts": [
+                {
+                    "endpoint": list(h["endpoint"]),
+                    "alive": h["alive"],
+                    "requests": h["requests"],
+                    "reissued": h["reissued"],
+                }
+                for h in self._hosts
+            ],
+            "lost_hosts": self.lost_hosts,
+        }
+
+    def close(self) -> None:
+        for h in self._hosts:
+            with h["lock"]:
+                if h["client"] is not None:
+                    try:
+                        h["client"].close()
+                    except OSError:  # pragma: no cover
+                        pass
+                    h["client"] = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1789,3 +1789,25 @@ def stream_batches(
         config=config,
         tuner=tuner,
     )
+
+
+def host_shards(paths, rank: int | None = None, world: int | None = None):
+    """This host's slice of a tar-shard list: deterministic round-robin
+    (``paths[rank::world]``) over the SORTED names, so every member of a
+    process group derives a disjoint cover of the dataset from the same
+    listing with no coordination.  ``rank``/``world`` default from the
+    live process group (``parallel.distributed``) and collapse to
+    "everything" single-process — the multi-host data axis costs the
+    single-process path nothing.  Each host then streams its own shards
+    through :func:`stream_batches`; no bytes cross hosts at ingest."""
+    paths = sorted(str(p) for p in paths)
+    if rank is None or world is None:
+        from ..parallel import distributed as kdist
+
+        rank = kdist.process_index() if rank is None else rank
+        world = kdist.process_count() if world is None else world
+    if world <= 1:
+        return paths
+    if not (0 <= rank < world):
+        raise ValueError(f"rank {rank} outside world {world}")
+    return paths[rank::world]

@@ -113,13 +113,21 @@ def min_chip_budget(mesh) -> tuple[int | None, Any]:
     env, every device's live ``memory_stats()`` free bytes are read; if ANY
     participating chip cannot report (CPU backends), the answer is
     ``(None, None)`` — admission is skipped, never guessed from a subset of
-    the mesh."""
+    the mesh.  On a mesh spanning PROCESSES only the chips addressable
+    from this host are consulted — a remote chip's ``memory_stats()``
+    cannot be read here, and in a symmetric fleet the local minimum IS the
+    per-chip answer; a mesh with no local chips at all answers
+    ``(None, None)``."""
     raw = os.environ.get(HBM_BUDGET_ENV, "").strip()
     if raw:
         return parse_bytes(raw), None
+    me = jax.process_index()
+    local = [d for d in mesh.devices.flat if d.process_index == me]
+    if not local:
+        return None, None
     worst: int | None = None
     worst_dev = None
-    for dev in mesh.devices.flat:
+    for dev in local:
         free = hbm_budget(dev)
         if free is None:
             return None, None

@@ -26,6 +26,7 @@ pipeline has no substrate doing that, so the primitives live here:
 from __future__ import annotations
 
 import contextlib
+import errno
 import functools
 import logging
 import os
@@ -62,6 +63,18 @@ PERMANENT_ERRORS: tuple[type[BaseException], ...] = (
     NotADirectoryError,
     PermissionError,
 )
+
+
+def is_addr_in_use(e: BaseException) -> bool:
+    """Is this failure an ``EADDRINUSE`` bind collision?  Transient by
+    nature (auto-picked ports race between pick and bind; TIME_WAIT
+    lingers), so callers retry it — but it surfaces inconsistently: a
+    proper ``OSError`` with errno from Python sockets, an opaque
+    ``RuntimeError``/``XlaRuntimeError`` string from grpc-backed services
+    (the ``jax.distributed`` coordinator).  Both spellings are matched."""
+    if isinstance(e, OSError) and e.errno == errno.EADDRINUSE:
+        return True
+    return "address already in use" in str(e).lower()
 
 
 def _env_int(name: str, default: int) -> int:

@@ -265,6 +265,22 @@ class ServingEngine:
         #: object — mixing mesh-committed params into the eager apply
         #: would let placement errors masquerade as parity failures.
         self._oracle_pipe = pipe
+        if mesh is not None:
+            from ..parallel.mesh import host_local_mesh, mesh_spans_processes
+
+            if mesh_spans_processes(mesh):
+                # Serving never spans hosts: a request answered through a
+                # cross-process mesh would need every host's cooperation
+                # per request (one slow peer stalls the whole fleet, one
+                # dead peer aborts it).  Typed refusal with the fix named
+                # — anchor each host's engines on ITS sub-mesh and let the
+                # front-end fan requests across hosts.
+                raise ServeError(
+                    f"serving mesh spans processes — anchor on "
+                    f"host_local_mesh(mesh) instead "
+                    f"(this host owns {host_local_mesh(mesh).devices.size} "
+                    f"of the mesh's devices)"
+                )
         self.mesh = mesh
         self._pipe = self._mesh_place(pipe, mesh) if mesh is not None else pipe
         self.label = label

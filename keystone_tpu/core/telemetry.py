@@ -95,6 +95,15 @@ POSTMORTEM_KINDS = frozenset(
         # hot-swapped, the mesh they landed on, and the in-flight counters
         # at the moment the substrate shrank.
         "mesh_reanchor",
+        # Multi-host serving (ISSUE 17): losing a HOST is the
+        # topology-loss event one tier up — the survivor's re-anchor onto
+        # its host-local mesh ("host_reanchor"), the front-end declaring a
+        # fleet member dead ("fleet_host_lost"), and a peer that never
+        # joined the process group ("dist_join_timeout") all warrant
+        # last-moments evidence.
+        "host_reanchor",
+        "fleet_host_lost",
+        "dist_join_timeout",
     }
 )
 

@@ -1,0 +1,516 @@
+"""Multi-host process-group bring-up: ``jax.distributed`` behind the
+``make_mesh`` API, with membership the serving fleet can shrink.
+
+Two layers, deliberately separate:
+
+* **``jax.distributed`` bring-up** (:func:`init_process_group`) for the
+  fit path, where cross-host collectives are worth their coupling: gloo
+  CPU collectives are enabled so multi-process CPU computations work at
+  all, the coordinator port is auto-picked (:func:`pick_coordinator`)
+  with bounded retry on ``EADDRINUSE`` (counted ``dist_port_retry``),
+  and the join is deadline-guarded — a slow or dead peer becomes a typed
+  :class:`~keystone_tpu.core.resilience.DeadlineExceeded` (counted
+  ``dist_join_timeout``), never a hang.  Once initialised,
+  ``jax.devices()`` is GLOBAL, so the existing ``make_mesh()`` /
+  ``enumerate_meshes()`` calls build a data axis spanning hosts with no
+  new API.
+* **Fleet membership** (:class:`GroupState`, :func:`reform_group`) for
+  the serving path.  jax's coordination client cannot survive peer death
+  in-process (a lost peer's heartbeat failure poisons the client and a
+  later ``jax.distributed.shutdown()`` fatally aborts the process), so
+  serving hosts keep jax HOST-LOCAL — no collectives on the serve hot
+  path — and track world/rank in keystone's own group record, which
+  :func:`reform_group` reduces in place when the front-end declares a
+  peer dead (counted ``dist_reform``).  This is the production-fleet
+  shape: inference hosts share routing and checkpoints, not an XLA
+  communicator.
+
+Single-process discipline: with nothing configured (no
+``KEYSTONE_DIST_*`` env, no explicit ``world``), every entry point here
+is inert — :func:`process_count` answers 1 and :func:`process_index` 0
+WITHOUT importing jax, so decode workers and the serve hot path pay
+nothing.  jax is imported lazily inside the functions that need it.
+
+Env knobs (README ``KEYSTONE_*`` table):
+
+* ``KEYSTONE_DIST_COORD`` — coordinator ``host:port``.
+* ``KEYSTONE_DIST_PROCS`` / ``KEYSTONE_DIST_RANK`` — world size and this
+  process's rank.
+* ``KEYSTONE_DIST_JOIN_TIMEOUT_S`` — per-peer join deadline (default 60).
+* ``KEYSTONE_DIST_PORT_RETRIES`` — coordinator bind retries on
+  ``EADDRINUSE`` (default 4).
+* ``KEYSTONE_DIST_DISABLE`` — force :func:`spawn_available` False (CI
+  hosts without spawn/ports).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import socket
+import sys
+import threading
+import time
+
+from ..core.resilience import (
+    DeadlineExceeded,
+    counters,
+    is_addr_in_use,
+)
+
+COORD_ENV = "KEYSTONE_DIST_COORD"
+PROCS_ENV = "KEYSTONE_DIST_PROCS"
+RANK_ENV = "KEYSTONE_DIST_RANK"
+JOIN_TIMEOUT_ENV = "KEYSTONE_DIST_JOIN_TIMEOUT_S"
+PORT_RETRIES_ENV = "KEYSTONE_DIST_PORT_RETRIES"
+DISABLE_ENV = "KEYSTONE_DIST_DISABLE"
+
+DEFAULT_JOIN_TIMEOUT_S = 60.0
+DEFAULT_PORT_RETRIES = 4
+
+_logger = logging.getLogger("keystone_tpu.distributed")
+
+_lock = threading.Lock()
+
+
+@dataclasses.dataclass
+class GroupState:
+    """The live process-group record.  ``jax_initialized`` says whether a
+    real ``jax.distributed`` communicator backs it (fit path) or the
+    group is keystone-managed membership only (serving fleet)."""
+
+    world: int
+    rank: int
+    coordinator: str
+    jax_initialized: bool = False
+    epoch: int = 0  #: bumped by every :func:`reform_group`
+    lost: tuple = ()  #: original ranks declared dead across reforms
+
+    def record(self) -> dict:
+        return {
+            "world": self.world,
+            "rank": self.rank,
+            "coordinator": self.coordinator,
+            "jax": self.jax_initialized,
+            "epoch": self.epoch,
+            "lost": list(self.lost),
+        }
+
+
+_state: GroupState | None = None
+_threads_before_init: frozenset[int] | None = None
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int | None) -> int | None:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+# -- ports and availability ---------------------------------------------------
+
+
+def pick_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (bind to 0, read, release).  The
+    release-to-bind window is racy by nature; the consumer
+    (:func:`init_process_group`) retries ``EADDRINUSE`` rather than
+    trusting the pick."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def pick_coordinator(host: str = "127.0.0.1") -> str:
+    """Auto-picked ``host:port`` coordinator address for a launcher to
+    hand every worker."""
+    return f"{host}:{pick_port(host)}"
+
+
+def spawn_available() -> bool:
+    """Can this host run the multi-process path at all: POSIX, a usable
+    ``sys.executable``, and the loopback port space open.  The ``dist``
+    pytest marker and every ``--hosts N`` tool degrade to the
+    single-process path when this is False (or ``KEYSTONE_DIST_DISABLE``
+    is set) — multi-process is a capability, never a requirement."""
+    if os.environ.get(DISABLE_ENV, "").strip() in ("1", "true", "yes"):
+        return False
+    if os.name != "posix":
+        return False
+    if not sys.executable or not os.path.exists(sys.executable):
+        return False
+    try:
+        pick_port()
+    except OSError:
+        return False
+    return True
+
+
+# -- group state --------------------------------------------------------------
+
+
+def is_initialized() -> bool:
+    return _state is not None
+
+
+def group_state() -> GroupState | None:
+    return _state
+
+
+def process_count() -> int:
+    """World size — 1 when no group is configured (no jax import on the
+    inert path)."""
+    return _state.world if _state is not None else 1
+
+
+def process_index() -> int:
+    """This process's rank — 0 when no group is configured."""
+    return _state.rank if _state is not None else 0
+
+
+# -- bring-up -----------------------------------------------------------------
+
+
+def _enable_cpu_collectives() -> None:
+    """The default CPU backend refuses multi-process computations
+    outright; gloo is the collectives implementation that works.  Must
+    run before ``jax.distributed.initialize``."""
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as e:  # non-CPU backends / renamed flag: not fatal
+        _logger.debug("cpu collectives config not applied: %s", e)
+
+
+def init_process_group(
+    coordinator: str | None = None,
+    world: int | None = None,
+    rank: int | None = None,
+    *,
+    join_timeout_s: float | None = None,
+    port_retries: int | None = None,
+    use_jax: bool = True,
+) -> GroupState:
+    """Join (or create) the process group.  Arguments default from the
+    ``KEYSTONE_DIST_*`` env; with nothing configured this is an inert
+    no-op returning a solo :class:`GroupState` WITHOUT importing jax.
+
+    ``use_jax=True`` runs the real ``jax.distributed.initialize`` under
+    the join deadline: the coordinator (rank 0) retries ``EADDRINUSE``
+    up to ``port_retries`` times (counted ``dist_port_retry``), and a
+    join that outlives ``join_timeout_s`` — a dead coordinator, a peer
+    that never arrives — raises typed :class:`DeadlineExceeded` counted
+    ``dist_join_timeout``.  ``use_jax=False`` records keystone-level
+    membership only (the serving-fleet mode; jax stays host-local)."""
+    global _state, _threads_before_init
+    with _lock:
+        if _state is not None:
+            raise RuntimeError(
+                f"process group already initialised: {_state.record()} — "
+                "shutdown_process_group() first"
+            )
+        world = world if world is not None else _env_int(PROCS_ENV, None)
+        if world is None or world <= 0:
+            # Nothing configured: the single-process inert path.
+            _state = GroupState(world=1, rank=0, coordinator="", epoch=0)
+            return _state
+        rank = rank if rank is not None else (_env_int(RANK_ENV, 0) or 0)
+        coordinator = coordinator or os.environ.get(COORD_ENV, "").strip()
+        if not (0 <= rank < world):
+            raise ValueError(f"rank {rank} outside world {world}")
+        if world > 1 and not coordinator:
+            raise ValueError(
+                f"world={world} needs a coordinator address "
+                f"({COORD_ENV} or coordinator=)"
+            )
+        if not coordinator:
+            coordinator = pick_coordinator()
+        if not use_jax:
+            _state = GroupState(world=world, rank=rank, coordinator=coordinator)
+            _logger.info("fleet group joined: %s", _state.record())
+            return _state
+
+        budget = (
+            join_timeout_s
+            if join_timeout_s is not None
+            else _env_float(JOIN_TIMEOUT_ENV, DEFAULT_JOIN_TIMEOUT_S)
+        )
+        retries = (
+            port_retries
+            if port_retries is not None
+            else (_env_int(PORT_RETRIES_ENV, DEFAULT_PORT_RETRIES) or 0)
+        )
+        import jax
+
+        _enable_cpu_collectives()
+        _threads_before_init = frozenset(
+            id(t) for t in threading.enumerate()
+        )
+        t0 = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                _join_with_deadline(jax, coordinator, world, rank, budget)
+                break
+            except DeadlineExceeded:
+                counters.record(
+                    "dist_join_timeout",
+                    f"rank {rank}/{world} join via {coordinator} "
+                    f"exceeded {budget:g}s",
+                )
+                raise
+            except Exception as e:
+                if is_addr_in_use(e) and rank == 0 and attempt < retries:
+                    attempt += 1
+                    counters.record(
+                        "dist_port_retry",
+                        f"coordinator {coordinator} in use "
+                        f"(attempt {attempt}/{retries})",
+                    )
+                    time.sleep(0.05 * attempt)
+                    continue
+                if _looks_like_timeout(e):
+                    counters.record(
+                        "dist_join_timeout",
+                        f"rank {rank}/{world} join via {coordinator}: {e}",
+                    )
+                    raise DeadlineExceeded(
+                        f"dist_join[{rank}/{world}]", budget
+                    ) from e
+                raise
+        _state = GroupState(
+            world=world, rank=rank, coordinator=coordinator,
+            jax_initialized=True,
+        )
+        _logger.info(
+            "process group up in %.2fs: %s (%d global devices)",
+            time.monotonic() - t0, _state.record(), len(jax.devices()),
+        )
+        return _state
+
+
+def _join_with_deadline(jax, coordinator, world, rank, budget) -> None:
+    """Run ``jax.distributed.initialize`` under a REAL deadline.
+
+    ``jax.distributed.initialize`` blocks inside ``client.connect()``,
+    in C++ where neither SIGALRM nor KeyboardInterrupt can reach, and
+    its own deadlines are the wrong shape: the coordinator waiting for a
+    peer that never arrives sits under XLA's cluster-register timeout
+    (~an hour), and where ``initialization_timeout`` DOES fire (the
+    joiner's register RPC) client.h treats it as fatal and terminates
+    the process.  So the join runs on a helper thread and THIS thread
+    owns the clock: past the budget the caller gets a typed
+    :class:`DeadlineExceeded` and the stuck join thread is abandoned
+    (daemon — it dies with the process, and a bring-up failure means the
+    launcher replaces the process anyway)."""
+    box: dict = {}
+
+    def run():
+        try:
+            # jax's own timeout is pushed PAST ours on purpose: when the
+            # C++ RegisterTask deadline fires first, client.h declares it
+            # fatal and TERMINATES the process — no Python frame ever
+            # sees it.  With the keystone clock in front, the caller gets
+            # the typed fault, records it, and decides; a process that
+            # lingers with the poisoned client may still be aborted by
+            # the late C++ deadline, so a failed bring-up means REPLACE
+            # the process, not retry in it.
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=world,
+                process_id=rank,
+                initialization_timeout=max(1, int(budget * 2)) + 5,
+            )
+        except BaseException as e:  # noqa: BLE001 — re-raised by caller
+            box["error"] = e
+
+    t = threading.Thread(target=run, name=f"dist-join-{rank}", daemon=True)
+    t.start()
+    t.join(budget)
+    if t.is_alive():
+        raise DeadlineExceeded(f"dist_join[{rank}/{world}]", budget)
+    if "error" in box:
+        raise box["error"]
+
+
+def _looks_like_timeout(e: BaseException) -> bool:
+    msg = str(e).lower()
+    return any(
+        tok in msg
+        for tok in ("timed out", "timeout", "deadline exceeded", "unavailable")
+    )
+
+
+def shutdown_process_group(join_timeout_s: float = 5.0) -> list[str]:
+    """Leave the group and tear the coordinator/client service down.
+    Returns the names of any service threads still alive after
+    ``join_timeout_s`` — callers assert ``== []`` the way a stream's
+    ``join()`` is asserted, so a leak is a test failure, not a slow
+    accumulation.  Idempotent; inert when no group was initialised."""
+    global _state, _threads_before_init
+    with _lock:
+        st, _state = _state, None
+        before, _threads_before_init = _threads_before_init, None
+    if st is None or not st.jax_initialized:
+        return []
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:
+        counters.record("dist_shutdown_error", str(e))
+        raise
+    leaked: list[str] = []
+    end = time.monotonic() + max(0.0, join_timeout_s)
+    while True:
+        leaked = [
+            t.name
+            for t in threading.enumerate()
+            if t.is_alive()
+            and (before is None or id(t) not in before)
+            and t is not threading.current_thread()
+        ]
+        if not leaked or time.monotonic() >= end:
+            break
+        time.sleep(0.05)
+    if leaked:
+        counters.record("dist_thread_leak", ",".join(leaked))
+    return leaked
+
+
+def reform_group(survivors) -> GroupState:
+    """Re-form the group as the ``survivors`` (original ranks, order
+    fixed across hosts so every survivor derives the same new world).
+    Counted ``dist_reform``.  A ``jax.distributed`` communicator is NOT
+    re-formed in place — a dead peer has already poisoned the
+    coordination client, and touching it (even ``shutdown``) fatally
+    aborts the process — so the group downgrades to keystone-managed
+    membership and jax work continues HOST-LOCAL; the caller reshards
+    state via ``load_pipeline(mesh=)`` and re-anchors its routers."""
+    global _state
+    with _lock:
+        if _state is None:
+            raise RuntimeError("no process group to re-form")
+        survivors = sorted(int(s) for s in survivors)
+        if _state.rank not in survivors:
+            raise ValueError(
+                f"rank {_state.rank} is not among survivors {survivors}"
+            )
+        if not all(0 <= s < _state.world for s in survivors):
+            raise ValueError(
+                f"survivors {survivors} outside world {_state.world}"
+            )
+        lost = tuple(
+            sorted(
+                set(range(_state.world)) - set(survivors)
+                | set(_state.lost)
+            )
+        )
+        new = GroupState(
+            world=len(survivors),
+            rank=survivors.index(_state.rank),
+            coordinator=_state.coordinator,
+            jax_initialized=False,
+            epoch=_state.epoch + 1,
+            lost=lost,
+        )
+        counters.record(
+            "dist_reform",
+            f"world {_state.world}->{new.world} "
+            f"rank {_state.rank}->{new.rank} lost={list(lost)}",
+        )
+        if _state.jax_initialized:
+            _logger.warning(
+                "leaving poisoned jax.distributed client behind "
+                "(peer death; shutdown would abort) — jax is host-local "
+                "from here"
+            )
+        _state = new
+        return new
+
+
+# -- deterministic cross-host reduction ---------------------------------------
+
+
+def deterministic_allreduce(partial):
+    """Sum per-host partials in FIXED rank order — the bit-identity
+    primitive.  XLA's cross-process reductions are not bit-identical to
+    a single-process run (reduction order differs with topology), so the
+    fit path reduces HOST-SIDE: every rank's partial is allgathered
+    (exact byte transport, no arithmetic) into a ``(world, ...)`` stack
+    and summed by the same ``np.sum(axis=0)`` the single-process
+    reference applies to its per-group partials.  Same values, same op,
+    same order → bit-identical by construction.  World 1 returns the
+    partial unchanged."""
+    import numpy as np
+
+    x = np.asarray(partial)
+    if _state is None or _state.world <= 1 or not _state.jax_initialized:
+        return x
+    from jax.experimental import multihost_utils
+
+    stacked = np.asarray(multihost_utils.process_allgather(x))
+    if stacked.shape[0] != _state.world:  # pragma: no cover - invariant
+        raise RuntimeError(
+            f"allgather returned {stacked.shape[0]} parts for world "
+            f"{_state.world}"
+        )
+    return stacked.sum(axis=0)
+
+
+def barrier(name: str = "keystone") -> None:
+    """Cross-host sync point (jax-backed groups only; solo is a no-op)."""
+    if _state is None or _state.world <= 1 or not _state.jax_initialized:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+# -- launcher helpers ---------------------------------------------------------
+
+
+def _xla_flags_with_device_count(flags: str, n: int) -> str:
+    """Rewrite ``--xla_force_host_platform_device_count`` in an XLA_FLAGS
+    string (workers must not inherit the parent's virtual device count)."""
+    kept = [
+        tok
+        for tok in (flags or "").split()
+        if not tok.startswith("--xla_force_host_platform_device_count")
+    ]
+    kept.append(f"--xla_force_host_platform_device_count={int(n)}")
+    return " ".join(kept)
+
+
+def worker_env(
+    rank: int,
+    world: int,
+    coordinator: str,
+    *,
+    local_devices: int = 2,
+    base: dict | None = None,
+) -> dict:
+    """Environment for one spawned worker host: CPU platform pinned,
+    ``local_devices`` virtual CPU devices (replacing any inherited
+    count), and the ``KEYSTONE_DIST_*`` triple set."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = _xla_flags_with_device_count(
+        env.get("XLA_FLAGS", ""), local_devices
+    )
+    env[COORD_ENV] = coordinator
+    env[PROCS_ENV] = str(int(world))
+    env[RANK_ENV] = str(int(rank))
+    return env

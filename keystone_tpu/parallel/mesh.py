@@ -107,6 +107,35 @@ def enumerate_meshes(devices) -> list[Mesh]:
     return list(cached)
 
 
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """Does this mesh place shards on devices owned by OTHER processes?
+    After ``jax.distributed`` bring-up ``jax.devices()`` is global, so the
+    existing ``make_mesh()`` transparently builds a data axis spanning
+    hosts — and every consumer that stages host memory, reads
+    ``memory_stats()``, or serves requests must know whether all of the
+    mesh is addressable from here.  Always False single-process."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def host_local_mesh(mesh: Mesh | None = None) -> Mesh:
+    """The largest pure-data mesh over THIS process's addressable devices.
+    ``mesh`` given: its local sub-mesh (the serving anchor for a host in a
+    fleet — engines never span hosts); omitted: all local devices.  Device
+    order follows ``jax.local_devices()`` so every host derives the same
+    shape for a symmetric fleet."""
+    if mesh is None:
+        local = list(jax.local_devices())
+    else:
+        me = jax.process_index()
+        local = [d for d in mesh.devices.flat if d.process_index == me]
+        if not local:
+            raise ValueError(
+                f"mesh {mesh_desc(mesh)} has no devices on process {me}"
+            )
+    return make_mesh(data=len(local), model=1, devices=local)
+
+
 _current_mesh: list[Mesh] = []
 
 

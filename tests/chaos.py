@@ -117,6 +117,16 @@ draws its parameters — fully deterministic):
   ``mesh=`` reshard path) then resume onto the survivors via
   ``load_pipeline(mesh=)`` with predictions bit-equal to the fault-free
   full-mesh run.
+* ``host_loss`` — a serving HOST dies mid-flight (ISSUE 17): a fleet of
+  wire-served host routers (REAL subprocesses where spawn is available,
+  in-process wire servers otherwise) loses one member under live
+  traffic — the front-end counts the loss (``fleet_host_lost``) and
+  reissues the dead host's in-flight requests to survivors, the
+  survivors re-form the reduced group (``dist_reform``), reshard the
+  checkpointed state host-locally and hot-swap their engines (counted
+  ``host_reanchor``, postmortem-linked); every request is answered
+  bit-equal to the offline oracle — zero dropped, never a silent wrong
+  answer.
 """
 
 from __future__ import annotations
@@ -181,6 +191,7 @@ FAMILIES = (
     "profiler_crash",
     "output_drift",
     "mesh_shrink",
+    "host_loss",
 )
 
 #: The serving-path families (core.serve / core.frontend / core.wire),
@@ -196,8 +207,8 @@ SERVE_FAMILIES = (
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(23))
-FULL_SEEDS = tuple(range(46))
+TIER1_SEEDS = tuple(range(24))
+FULL_SEEDS = tuple(range(48))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -389,6 +400,14 @@ def make_schedule(seed: int) -> Fault:
                 # how much of the 4-device full mesh survives the loss
                 "survivors": int(rng.integers(1, 3)),
                 "hold_seconds": 0.25,
+            },
+        )
+    if kind == "host_loss":
+        return Fault(
+            kind,
+            {
+                "hosts": 2,  # tools/chaos_run.py --hosts N overrides via env
+                "requests": int(rng.integers(14, 25)),
             },
         )
     return Fault("deadline", {"seconds": 1.0})
@@ -1680,6 +1699,68 @@ def _mesh_shrink_phase(fault: Fault, tmpdir: str, seed: int) -> None:
         )
 
 
+def _host_loss_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """A serving host dies mid-flight (ISSUE 17): drive the multi-host
+    drill (real subprocesses where spawn is available, the in-process
+    wire fleet otherwise) and hold it to the never-silent bar — every
+    request answered bit-equal to the offline oracle, zero dropped, the
+    loss counted ``fleet_host_lost``, the survivors re-formed
+    (``dist_reform``) and re-anchored (``host_reanchor``,
+    postmortem-linked)."""
+    from keystone_tpu.workloads.multihost import run_host_loss_drill
+
+    hosts = int(
+        os.environ.get("KEYSTONE_CHAOS_HOSTS", fault.params["hosts"])
+    )
+    lost_before = counters.get("fleet_host_lost")
+    reanchor_before = counters.get("host_reanchor")
+    rec = run_host_loss_drill(
+        tmpdir,
+        hosts=hosts,
+        requests=int(fault.params["requests"]),
+        seed=seed,
+        timeout_s=180.0,
+    )
+    if rec["dropped_requests"] != 0:
+        raise ChaosOracleError(
+            f"host loss dropped {rec['dropped_requests']} request(s) "
+            f"({rec['answered']}/{rec['requests']} answered; "
+            f"errors: {rec['errors']})"
+        )
+    if rec["mismatches"] != 0:
+        raise ChaosOracleError(
+            f"{rec['mismatches']} answer(s) differ from the offline "
+            "oracle after the host loss — silent wrong answers"
+        )
+    if rec["errors"]:
+        raise ChaosOracleError(
+            f"fleet clients saw errors across the loss: {rec['errors']}"
+        )
+    for r, sc in rec["survivor_counters"].items():
+        if sc.get("dist_reform", 0) < 1:
+            raise ChaosOracleError(
+                f"survivor {r} never re-formed the group: {sc}"
+            )
+        if sc.get("host_reanchor", 0) < 1:
+            raise ChaosOracleError(
+                f"survivor {r} never re-anchored its engines: {sc}"
+            )
+    if counters.get("fleet_host_lost") - lost_before < 1:
+        raise ChaosOracleError(
+            "the front-end never counted the host loss (fleet_host_lost)"
+        )
+    if counters.get("host_reanchor") - reanchor_before < 1:
+        raise ChaosOracleError(
+            "the re-anchor was never counted controller-side "
+            "(host_reanchor)"
+        )
+    pm = [p for p in rec["postmortems"] if "host_reanchor" in p]
+    if not pm:
+        raise ChaosOracleError(
+            f"no host_reanchor postmortem dumped (got {rec['postmortems']})"
+        )
+
+
 def _stepdown_oracle(
     res: dict,
     stepdown_delta: int,
@@ -1769,6 +1850,10 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "mesh_shrink":
         _mesh_shrink_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "host_loss":
+        _host_loss_phase(fault, tmpdir, seed)
         return _run_workload(workload)
 
     if fault.kind == "stream_hang":

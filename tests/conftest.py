@@ -75,3 +75,28 @@ def pytest_configure(config):
         "-m chaos (full-schedule tests are also marked slow so the tier-1 "
         "'-m not slow' filter excludes them)",
     )
+    config.addinivalue_line(
+        "markers",
+        "dist: multi-process jax.distributed tests — REAL subprocesses on "
+        "auto-picked ports; auto-skipped where spawn or port binding is "
+        "unavailable (parallel.distributed.spawn_available)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """dist-marked tests need subprocess spawn + a bindable loopback port;
+    on hosts without either they skip with the reason named, they do not
+    fail."""
+    dist_items = [it for it in items if it.get_closest_marker("dist")]
+    if not dist_items:
+        return
+    from keystone_tpu.parallel.distributed import spawn_available
+
+    if spawn_available():
+        return
+    skip = pytest.mark.skip(
+        reason="multi-process unavailable (no spawn or no bindable port; "
+        "see KEYSTONE_DIST_DISABLE)"
+    )
+    for it in dist_items:
+        it.add_marker(skip)

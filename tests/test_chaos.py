@@ -39,6 +39,7 @@ EXPECTED_COUNTER = {
     "profiler_crash": "profiler_sampler_crash",
     "output_drift": "serve_output_drift",
     "mesh_shrink": "mesh_reanchor",
+    "host_loss": "host_reanchor",
 }
 
 
@@ -62,7 +63,7 @@ def test_chaos_schedule_mnist(seed, tmp_path):
     trace_path = str(tmp_path / f"chaos_seed{seed}.json")
     r = chaos.run_schedule(
         seed, "mnist", tmpdir=str(tmp_path), trace_path=trace_path
-    )  # 23 families as of ISSUE 16 (mesh_shrink)
+    )  # 24 families as of ISSUE 17 (host_loss)
     _check(r)
     violations = chaos.verify_trace(trace_path, r)
     assert violations == [], {
@@ -128,6 +129,13 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # must resume onto the survivors predictions-equal — never a silent
     # divergence, never a crash for a mesh the process still has
     assert "mesh_shrink" in kinds
+    # Multi-host coverage (ISSUE 17): a serving HOST dying mid-flight
+    # must be counted fleet_host_lost with its in-flight requests
+    # reissued to survivors, the reduced group re-formed (dist_reform)
+    # and every survivor re-anchored (host_reanchor, postmortem-linked)
+    # — zero dropped requests, every answer bit-equal to the offline
+    # oracle
+    assert "host_loss" in kinds
 
 
 def test_schedules_are_deterministic():

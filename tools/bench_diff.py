@@ -127,6 +127,16 @@ DEFAULT_METRICS: tuple = (
     # against a zero base is a regression, see compare()).
     ("extra_metrics.serving.reshard_wall_s", "lower", 0.50),
     ("extra_metrics.serving.reanchor_dropped_requests", "lower", 0.00),
+    # ISSUE 17: multi-host elastic serving — the 2-process fit+serve wall
+    # and its crosshost checkpoint-reshard wall must not creep, the
+    # host-loss drill's survivor re-anchor must stay fast, and the fleet
+    # must never drop a request across the loss (zero stays zero).  On
+    # spawn-less hosts the section records zero-base rows, which compare
+    # clean against themselves.
+    ("extra_metrics.multihost.fit_serve_wall_s", "lower", 0.50),
+    ("extra_metrics.multihost.reshard_wall_s", "lower", 0.50),
+    ("extra_metrics.multihost.host_loss.reanchor_wall_s", "lower", 0.50),
+    ("extra_metrics.multihost.host_loss.dropped_requests", "lower", 0.00),
 )
 
 

@@ -22,9 +22,10 @@ appear in its schedule's trace as a counted ``fault`` instant event with a
 matching ``kind`` attribute, and a typed-error outcome must be visible as a
 failed span carrying the error type — typed-error spans are never silent.
 A schedule whose trace misses either fails the run like any other
-violation.  The assertion covers ALL 21 fault families (the streaming,
-snapshot, decode-worker, serving, wire-protocol, and placement families
-included) and the tier-1 suite runs every schedule traced
+violation.  The assertion covers ALL 24 fault families (the streaming,
+snapshot, decode-worker, serving, wire-protocol, placement, elastic-mesh,
+and multi-host families included) and the tier-1 suite runs every schedule
+traced
 (tests/test_chaos.py), so the invariant is continuously enforced, not just
 on demand.
 
@@ -72,6 +73,15 @@ def main(argv=None) -> int:
     )
     p.add_argument("--workload", default="mnist", choices=("mnist", "cifar"))
     p.add_argument(
+        "--hosts",
+        type=int,
+        default=None,
+        metavar="N",
+        help="size of the serving fleet the host_loss family spawns "
+        "(default 2; real subprocesses where spawn is available) — sets "
+        "KEYSTONE_CHAOS_HOSTS for the drill",
+    )
+    p.add_argument(
         "--trace",
         default=None,
         metavar="DIR",
@@ -80,6 +90,12 @@ def main(argv=None) -> int:
         "(typed-error spans never silent)",
     )
     a = p.parse_args(argv)
+
+    if a.hosts is not None:
+        if a.hosts < 2:
+            print("--hosts must be >= 2 (one host must die)", file=sys.stderr)
+            return 2
+        os.environ["KEYSTONE_CHAOS_HOSTS"] = str(a.hosts)
 
     # Hermetic placement search: the plan_mispredict oracle (and every
     # bit-equality judge) assumes the COLD search ranking — a trained

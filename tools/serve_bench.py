@@ -25,10 +25,19 @@ The first stdout line is the machine-readable JSON record (the bench.py
 convention); human-readable lines follow.  Exit 0 on success, 1 on any
 failed client or lost request.
 
+``--hosts N`` benches the multi-host fleet front (ISSUE 17): N REAL
+serve-host worker processes (keystone_tpu.workloads.multihost), each a
+host-local ShapeRouter behind a WireServer, fronted by a
+:class:`~keystone_tpu.core.frontend.HostFleet`; ``--kill-host R``
+additionally SIGKILLs rank R mid-flight and proves the survivors
+re-anchor with zero lost requests.
+
 Usage:
     python tools/serve_bench.py                        # in-process
     python tools/serve_bench.py --wire --clients 4     # real sockets
     python tools/serve_bench.py --wire --shift         # + mix-shift replay
+    python tools/serve_bench.py --hosts 2              # multi-host fleet
+    python tools/serve_bench.py --hosts 3 --kill-host 2  # + host loss
 """
 
 from __future__ import annotations
@@ -282,6 +291,162 @@ def run_shift(router, ws, shapes, timeout) -> dict:
     return out
 
 
+def run_hosts(a) -> int:
+    """--hosts N (ISSUE 17): spawn N REAL serve-host worker processes
+    (keystone_tpu.workloads.multihost serve-host, toy scaler mode), front
+    them with a :class:`~keystone_tpu.core.frontend.HostFleet`, and drive
+    the request stream through the fleet — per-request p50/p99 across
+    hosts, per-host request counts, and with ``--kill-host R`` the
+    host-loss drill: SIGKILL rank R mid-flight, survivors re-form the
+    reduced group and re-anchor (the ack carries ``reanchor_wall_s``)
+    while the fleet reissues — zero lost requests or exit 1."""
+    import queue
+    import tempfile
+
+    from keystone_tpu.core import frontend as kfrontend
+    from keystone_tpu.parallel import distributed as kdist
+    from keystone_tpu.workloads import multihost as mh
+
+    record: dict = {
+        "metric": "serve_bench",
+        "hosts": a.hosts,
+        "requests_per_client": a.requests,
+    }
+    if not kdist.spawn_available():
+        # Clean single-process degrade: the record says why nothing ran.
+        record.update(multihost_unavailable=True, ok=True)
+        print(json.dumps(record), flush=True)
+        print("# multihost: process spawn unavailable — nothing benched")
+        return 0
+    if a.kill_host is not None and not 0 <= a.kill_host < a.hosts:
+        print(json.dumps({**record, "ok": False,
+                          "error": f"--kill-host {a.kill_host} out of range"}))
+        return 2
+
+    clients = a.clients or 4
+    n = clients * a.requests
+    record["clients"] = clients
+    rng = np.random.default_rng(7)
+    rows = [rng.normal(size=mh.FEAT_DIM).astype(np.float32)
+            for _ in range(n)]
+
+    t0 = time.perf_counter()
+    tmpdir = tempfile.mkdtemp(prefix="serve_bench_hosts_")
+    workers: list = []
+    ok = True
+    errors: list = []
+    results: list = [None] * n
+    lat_ms: list = [None] * n
+    try:
+        for r in range(a.hosts):
+            env = mh._hermetic_env(
+                kdist.worker_env(r, a.hosts, "controller", local_devices=2),
+                tmpdir, f"host{r}",
+            )
+            workers.append(mh._WorkerIO(
+                mh._worker_cmd("serve-host", ["--seed", "7"]),
+                env, os.path.join(tmpdir, f"host{r}.err"),
+            ))
+        up = [w.expect("port", a.timeout / 2) for w in workers]
+        endpoints = [("127.0.0.1", m["port"]) for m in up]
+        record["bringup_seconds"] = round(time.perf_counter() - t0, 3)
+
+        idx_q: "queue.Queue" = queue.Queue()
+        for i in range(n):
+            idx_q.put(i)
+
+        with kfrontend.HostFleet(endpoints, label="serve_bench") as fleet:
+
+            def work():
+                while True:
+                    try:
+                        i = idx_q.get_nowait()
+                    except queue.Empty:
+                        return
+                    s = time.perf_counter()
+                    try:
+                        results[i] = np.asarray(fleet.predict(rows[i]))
+                        lat_ms[i] = (time.perf_counter() - s) * 1000.0
+                    except Exception as e:  # noqa: BLE001 — judged below
+                        errors.append(f"req {i}: {type(e).__name__}: {e}")
+
+            pool = [
+                threading.Thread(
+                    target=work, name=f"fleet-client-{t}", daemon=True
+                )
+                for t in range(clients)
+            ]
+            for t in pool:
+                t.start()
+            if a.kill_host is not None:
+                mh._wait_answered(results, n // 3, a.timeout / 3)
+                workers[a.kill_host].kill()
+                record["killed_host"] = a.kill_host
+                record["killed_at_answered"] = mh._answered(results)
+                survivors = [
+                    r for r in range(a.hosts) if r != a.kill_host
+                ]
+                acks = {}
+                for r in survivors:
+                    workers[r].send(
+                        "peer_lost " + " ".join(str(s) for s in survivors)
+                    )
+                for r in survivors:
+                    acks[r] = workers[r].expect("ack", a.timeout / 2)
+                record["reanchor_wall_s"] = max(
+                    float(acks[r].get("reanchor_wall_s") or 0.0)
+                    for r in survivors
+                )
+            end = time.monotonic() + a.timeout
+            for t in pool:
+                t.join(max(0.1, end - time.monotonic()))
+            if any(t.is_alive() for t in pool):
+                errors.append("fleet clients did not drain in time")
+            record["fleet"] = fleet.record()
+        live = [r for r in range(a.hosts) if r != a.kill_host]
+        for r in live:
+            workers[r].send("quit")
+        record["survivor_counters"] = {
+            r: workers[r].expect("final", a.timeout / 4)["final"]["counters"]
+            for r in live
+        }
+    finally:
+        record["worker_rcs"] = [w.finish() for w in workers]
+
+    answered = sorted(v for v in lat_ms if v is not None)
+    dropped = n - len(answered)
+    record["bench"] = {
+        "requests": len(answered),
+        "errors": errors,
+        "p50_ms": round(_percentile(answered, 0.50), 3) if answered else None,
+        "p99_ms": round(_percentile(answered, 0.99), 3) if answered else None,
+    }
+    record["dropped_requests"] = int(dropped)
+    ok = not errors and dropped == 0
+    record["ok"] = bool(ok)
+    record["seconds"] = round(time.perf_counter() - t0, 3)
+    print(json.dumps(record), flush=True)
+    b = record["bench"]
+    print(
+        f"# fleet: {a.hosts} host process(es), {b['requests']}/{n} "
+        f"requests answered, p50 {b['p50_ms']}ms, p99 {b['p99_ms']}ms"
+    )
+    for h in record["fleet"]["hosts"]:
+        print(
+            f"# host {h['endpoint']}: alive={h['alive']} "
+            f"requests={h['requests']} reissued={h['reissued']}"
+        )
+    if a.kill_host is not None:
+        print(
+            f"# host-loss: killed host {a.kill_host} at "
+            f"{record.get('killed_at_answered')} answered, reanchor wall "
+            f"{record.get('reanchor_wall_s')}s, {dropped} dropped"
+        )
+    for err in errors:
+        print(f"# ERROR {err}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("serve_bench")
     p.add_argument(
@@ -314,8 +479,27 @@ def main(argv=None) -> int:
         "(KEYSTONE_NUMERICS equivalent): per-bucket output probes + drift "
         "verdicts land in the record's router/numerics sections",
     )
+    p.add_argument(
+        "--hosts", type=int, default=None, metavar="N",
+        help="multi-host fleet bench (ISSUE 17): spawn N serve-host "
+        "worker PROCESSES and drive the stream through a HostFleet; "
+        "degrades to a no-op record where process spawn is unavailable",
+    )
+    p.add_argument(
+        "--kill-host", type=int, default=None, metavar="R",
+        help="with --hosts: SIGKILL worker rank R mid-flight — survivors "
+        "re-form the group and re-anchor while the fleet reissues; zero "
+        "lost requests or exit 1",
+    )
     p.add_argument("--timeout", type=float, default=120.0)
     a = p.parse_args(argv)
+
+    if a.kill_host is not None and a.hosts is None:
+        p.error("--kill-host requires --hosts")
+    if a.hosts is not None:
+        if a.hosts < 2:
+            p.error("--hosts must be >= 2 (a fleet)")
+        return run_hosts(a)
 
     import contextlib
 
