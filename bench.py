@@ -2362,6 +2362,49 @@ def bench_multihost(rng):
     }
 
 
+def bench_lifecycle(rng):
+    """Closed-loop model lifecycle (core.lifecycle, ISSUE 18): the
+    drift→refit→validate→swap drill from tools/serve_bench.py — a
+    shifted mix trips the armed incumbent's drift monitor, the
+    controller warm-refits on fresh data, validates on a holdout, and
+    hot-swaps the router's engine while a pump thread keeps requests in
+    flight.  ``tools/bench_diff.py`` regresses on
+    ``lifecycle.refit_wall_s`` / ``lifecycle.swap_wall_s`` /
+    ``lifecycle.drift_to_healthy_wall_s`` (lower is better) and pins
+    ``lifecycle.dropped_requests`` at zero — the hot-swap's zero-downtime
+    claim, re-proven every round."""
+    import shutil
+    import sys as _sys
+    import tempfile
+
+    _tools = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    if _tools not in _sys.path:
+        _sys.path.insert(0, _tools)
+    from serve_bench import drift_refit_drill
+
+    tmp = tempfile.mkdtemp(prefix="bench_lifecycle_")
+    try:
+        drill = drift_refit_drill(tmp, requests=24, seed=0)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    # The full cycle record stays in the drill dict; keep the section's
+    # top level to the dotted paths the observatory reads.
+    return {
+        "tripped": drill.get("tripped"),
+        "outcome": (drill.get("cycle") or {}).get("outcome"),
+        "drift_to_healthy_wall_s": drill.get("drift_to_healthy_wall_s"),
+        "refit_wall_s": drill.get("refit_wall_s"),
+        "validate_wall_s": drill.get("validate_wall_s"),
+        "swap_wall_s": drill.get("swap_wall_s"),
+        "in_flight_across_swap": drill.get("in_flight_across_swap"),
+        "dropped_requests": drill.get("dropped_requests"),
+        "post_swap_bit_equal": drill.get("post_swap_bit_equal"),
+        "quality": (drill.get("cycle") or {}).get("quality"),
+        "statusz": drill.get("lifecycle"),
+        "ok": drill.get("ok", False),
+    }
+
+
 def bench_numerics(rng, serving: dict | None = None):
     """Numerics observatory (ISSUE 15): a laddered BCD fit runs MONITORED
     — the per-block κ table lands in ``FitReport.conditioning`` (the
@@ -2476,6 +2519,7 @@ def main():
     profiler_sec = _guarded(bench_profiler, rng)
     numerics_sec = _guarded(lambda r: bench_numerics(r, serving), rng)
     multihost_sec = _guarded(bench_multihost, rng)
+    lifecycle_sec = _guarded(bench_lifecycle, rng)
     at_scale = _guarded(bench_solve_at_scale, rng)
 
     # ONE atomic registry snapshot feeds both the back-compat "faults" key
@@ -2585,6 +2629,12 @@ def main():
             # drill's re-anchor wall with dropped_requests pinned at 0.
             # Zero-base rows (available: false) where spawn is off.
             "multihost": multihost_sec,
+            # Closed-loop model lifecycle (core.lifecycle, ISSUE 18): the
+            # drift→refit→validate→swap drill's walls (refit/swap/
+            # drift-to-healthy, all lower-is-better across rounds) with
+            # dropped_requests pinned at 0 — the zero-downtime hot-swap
+            # claim, re-proven every round.
+            "lifecycle": lifecycle_sec,
         },
     }
     # Regression observatory (ISSUE 11): this round judged against the
@@ -2785,6 +2835,18 @@ def main():
             f"reanchor {hl['reanchor_wall_s']}s, "
             f"{hl['dropped_requests']} dropped / {hl['mismatches']} "
             f"mismatched of {hl['answered']}"
+        )
+    lcx = ex["lifecycle"]
+    if "error" in lcx:
+        print(f"# lifecycle: {lcx['error'][:120]}")
+    else:
+        print(
+            f"# lifecycle: tripped on {lcx['tripped']}, {lcx['outcome']} in "
+            f"{lcx['drift_to_healthy_wall_s']}s (refit "
+            f"{lcx['refit_wall_s']}s, swap {lcx['swap_wall_s']}s), "
+            f"{lcx['in_flight_across_swap']} in flight across the swap, "
+            f"{lcx['dropped_requests']} dropped, bit-equal "
+            f"{lcx['post_swap_bit_equal']}"
         )
     bd = record["bench_diff"]
     if "verdict" in bd:

@@ -214,6 +214,7 @@ class RouterStats:
     warm_adds: int = 0  #: engines warmed from the observed mix
     retires: int = 0  #: engines retired (drained, closed, unregistered)
     rejected: int = 0  #: RetryLater answers (backpressure, retryable)
+    replaces: int = 0  #: atomic per-shape engine swaps (lifecycle refits)
     admission_denied: int = 0  #: warm adds denied by the shared HBM budget
     no_route: int = 0  #: NoRouteForShape answers (no factory — permanent)
 
@@ -415,6 +416,57 @@ class ShapeRouter:
         _logger.info(
             "router %s: engine %s live for shape %s (%d engine(s))",
             self.label, engine.label, key, n,
+        )
+        return key
+
+    def replace_engine(self, engine: ServingEngine, *, why: str = "engine swap") -> tuple:
+        """ATOMICALLY swap the engine serving ``engine.example_shape``:
+        the replacement registers under ONE routing-table update
+        (add-then-retire), so a request arriving at any instant routes to
+        the incumbent or the successor — a retire-then-add sequence would
+        open a window where a continuously-servable shape answers a
+        transient ``RetryLater``.  The incumbent (when present) drains
+        AFTER it is unrouted (:meth:`_retire_entry`: every in-flight
+        future resolves, zero request loss); with no incumbent this
+        degrades to :meth:`add_engine`.  Mix accounting (``routes``,
+        ``last_routed``) carries over so the idle-retire clock does not
+        restart on a swap.  Returns the routing key."""
+        key = tuple(int(d) for d in engine.example_shape)
+        with self._lock:
+            old = self._engines.get(key)
+            # SLO trackers and drift monitors unregister BY LABEL: a
+            # same-label successor would be unregistered by the
+            # incumbent's retirement.  Rename BEFORE the Server below
+            # registers the SLO tracker.
+            if old is not None and engine.label == old.engine.label:
+                engine.label = f"{old.engine.label}@swap"
+        server = Server(engine, config=self._server_config)
+        now = self._clock()
+        with self._lock:
+            if self._closed:
+                server.close()
+                server.join()
+                raise ServingUnavailable("router is closed")
+            old = self._engines.get(key)
+            entry = _Entry(key, engine, server, now)
+            if old is not None:
+                entry.routes = old.routes
+                entry.last_routed = old.last_routed
+                self.stats.replaces += 1
+            self._engines[key] = entry
+            n = len(self._engines)
+        trace.metrics.gauge("router_engines", n)
+        trace.instant(
+            "router_engine_added", shape=list(key), label=engine.label,
+            engines=n, replaced=old.engine.label if old is not None else None,
+        )
+        if old is not None:
+            self._retire_entry(old, why=why)
+        _logger.info(
+            "router %s: engine %s %s for shape %s (%s)",
+            self.label, engine.label,
+            "replaced " + old.engine.label if old is not None else "live",
+            key, why,
         )
         return key
 

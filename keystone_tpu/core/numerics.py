@@ -843,6 +843,27 @@ class DriftMonitor:
         except Exception:  # noqa: BLE001 — detection must never break serving
             _logger.exception("drift monitor %r failed", self.label)
 
+    def rearm(self, baseline: dict) -> None:
+        """Swap in a NEW fit-time baseline and reset the live window and
+        the latch (counted ``drift_rearmed``).  The lifecycle hot-swap
+        calls this after a refit lands so post-swap answers are judged
+        against the CANDIDATE's baseline from the swap instant — without
+        this, answers observed during validation/warmup contaminate the
+        live sketch and the stale baseline re-pages on the healthy new
+        model.  ``breaches`` is cumulative across re-arms (the monitor's
+        lifetime ledger)."""
+        with self._lock:
+            self.baseline = OutputSketch.from_record(baseline)
+            self.live = OutputSketch(self.baseline.kind)
+            self.latched = False
+            self.last_divergence = None
+        counters.record(
+            "drift_rearmed",
+            f"serve:{self.label}: drift monitor re-armed on a fresh "
+            f"fit-time baseline ({self.baseline.kind}, "
+            f"{self.baseline.observed} fit-time answers)",
+        )
+
     def record(self) -> dict:
         with self._lock:
             return {

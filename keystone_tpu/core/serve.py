@@ -577,6 +577,21 @@ class ServingEngine:
         if baseline:
             self.drift = knum.DriftMonitor(self.label, baseline)
 
+    def rearm_drift_baseline(self, baseline: dict | None) -> None:
+        """Re-arm drift detection on a NEW fit-time baseline (counted
+        ``drift_rearmed``) — the lifecycle hot-swap path.  Unlike
+        :meth:`arm_drift_baseline` this resets the live window and the
+        latch through :meth:`numerics.DriftMonitor.rearm`, so answers the
+        candidate produced during validation/warmup never contaminate the
+        post-swap judgment.  None is a no-op; an engine with no monitor
+        yet arms one."""
+        if not baseline:
+            return
+        if self.drift is None:
+            self.arm_drift_baseline(baseline)
+        else:
+            self.drift.rearm(baseline)
+
     def observe_output(self, host_rows, request_ids=None, bucket=None) -> None:
         """Numerics observatory hook on one bucket's ANSWERED rows: a
         tensor-stat probe (request ids as the NaN-provenance map) plus the

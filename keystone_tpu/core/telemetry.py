@@ -104,6 +104,16 @@ POSTMORTEM_KINDS = frozenset(
         "host_reanchor",
         "fleet_host_lost",
         "dist_join_timeout",
+        # Model lifecycle (ISSUE 18): the closed drift→refit→swap loop's
+        # decision points are postmortem-worthy — a refit landing
+        # ("lifecycle_refit", the swap evidence: generations, walls, the
+        # new baseline), a candidate judged WORSE than the incumbent and
+        # refused ("refit_rejected", the no-unvalidated-model invariant
+        # firing), and a refit cycle dying typed mid-flight
+        # ("refit_failed", the incumbent keeps serving).
+        "lifecycle_refit",
+        "refit_rejected",
+        "refit_failed",
     }
 )
 

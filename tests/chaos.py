@@ -127,6 +127,21 @@ draws its parameters — fully deterministic):
   ``host_reanchor``, postmortem-linked); every request is answered
   bit-equal to the offline oracle — zero dropped, never a silent wrong
   answer.
+* ``drift_refit`` — the closed lifecycle loop (ISSUE 18): a served
+  model's request mix shifts mid-serve and the drift monitor trips
+  (``serve_output_drift``); the :class:`~.core.lifecycle.
+  LifecycleController` must warm-refit the model over fresh-mix data,
+  validate it (finite + parity + holdout-quality gates), and hot-swap
+  the router atomically (counted ``lifecycle_refit``, postmortem-linked,
+  drift re-armed on the candidate's baseline) with requests IN FLIGHT
+  across the swap — zero dropped, every pre-swap answer bit-equal to the
+  incumbent's offline apply and every post-swap answer bit-equal to an
+  OFFLINE refit on the same data.  Injected refit OOM, validation
+  rejection (a candidate WORSE than the incumbent), and a mid-swap kill
+  must each degrade typed + counted (``refit_failed`` /
+  ``refit_rejected``) to the incumbent model — never a silent wrong
+  answer, never a gap in service — and a trip inside the cooldown is a
+  counted suppression (``refit_suppressed``), not a refit storm.
 """
 
 from __future__ import annotations
@@ -192,6 +207,7 @@ FAMILIES = (
     "output_drift",
     "mesh_shrink",
     "host_loss",
+    "drift_refit",
 )
 
 #: The serving-path families (core.serve / core.frontend / core.wire),
@@ -207,7 +223,7 @@ SERVE_FAMILIES = (
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(24))
+TIER1_SEEDS = tuple(range(25))
 FULL_SEEDS = tuple(range(48))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
@@ -408,6 +424,22 @@ def make_schedule(seed: int) -> Fault:
             {
                 "hosts": 2,  # tools/chaos_run.py --hosts N overrides via env
                 "requests": int(rng.integers(14, 25)),
+            },
+        )
+    if kind == "drift_refit":
+        return Fault(
+            kind,
+            {
+                # fit-time reference + shifted-mix sizes both clear
+                # numerics.DRIFT_MIN_COUNT with margin
+                "reference": int(rng.integers(48, 81)),
+                "shifted": int(rng.integers(48, 81)),
+                "shift_scale": float(rng.uniform(4.0, 8.0)),
+                # refit training rows (fresh post-shift world)
+                "rows": int(rng.integers(96, 161)),
+                # requests in flight across the hot-swap
+                "requests": int(rng.integers(6, 13)),
+                "hold_seconds": 0.2,
             },
         )
     return Fault("deadline", {"seconds": 1.0})
@@ -1811,6 +1843,322 @@ def _stepdown_oracle(
         )
 
 
+def _drift_refit_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """The closed lifecycle loop end-to-end (ISSUE 18) plus its fault
+    legs — see the module docstring's ``drift_refit`` bullet.
+
+    One deployment, five legs in sequence: (0) a shifted request mix
+    trips the armed drift monitor and the controller SEES the trip;
+    (A) a refit that OOMs materializing fresh features degrades typed +
+    counted ``refit_failed`` to the incumbent; (B) a candidate refit
+    over garbage labels is REJECTED by the holdout gate (counted
+    ``refit_rejected``) — never swapped; (C) a mid-swap kill (the router
+    dying under the replace) degrades typed + counted to the incumbent;
+    (D) the clean cycle lands: warm refit, validation, atomic hot-swap
+    with requests in flight (counted ``lifecycle_refit``, postmortem
+    dumped, drift re-armed on the candidate's baseline, zero dropped,
+    post-swap answers bit-equal to an OFFLINE refit); and (E) a trip
+    inside the fresh cooldown is a counted suppression
+    (``refit_suppressed``), not a refit storm."""
+    import glob as _glob
+
+    import jax.numpy as jnp
+
+    from keystone_tpu.core import frontend as kfrontend
+    from keystone_tpu.core import numerics as knum
+    from keystone_tpu.core import serve as kserve
+    from keystone_tpu.core import telemetry as ktelemetry
+    from keystone_tpu.core.lifecycle import LifecycleConfig, LifecycleController
+    from keystone_tpu.ops.stats import StandardScalerModel
+    from keystone_tpu.solvers.block import BlockLeastSquaresEstimator
+
+    rng = np.random.default_rng(seed)
+    n_ref = int(fault.params["reference"])
+    n_shift = int(fault.params["shifted"])
+    scale = float(fault.params["shift_scale"])
+    n_rows = int(fault.params["rows"])
+    n_req = int(fault.params["requests"])
+    hold = float(fault.params["hold_seconds"])
+
+    # Two worlds, one deployment: before the drift the truth is
+    # ``(x - mean0) @ T1``; after the mix shifts the truth is
+    # ``(x - mean0) @ T2`` — so the incumbent is genuinely WRONG on the
+    # new mix and a refit on fresh data genuinely fixes it (the quality
+    # gate has something real to judge).  Featurizer (mean-subtract) is
+    # exactly-rounded elementwise arithmetic, weights schedule-invariant.
+    wrng = np.random.default_rng(_DATA_SEED)
+    mean0 = wrng.normal(size=(16,)).astype(np.float32)
+    t1 = wrng.normal(size=(16, 4)).astype(np.float32)
+    t2 = wrng.normal(size=(16, 4)).astype(np.float32)
+    featurizer = StandardScalerModel(jnp.asarray(mean0), None)
+    shift = np.zeros(16, np.float32)
+    shift[int(np.argmax(np.abs(t1).sum(axis=1)))] = scale
+
+    def fit_model(feats, labels, checkpoint=None):
+        est = BlockLeastSquaresEstimator(block_size=16, num_iter=1, lam=0.0)
+        return est.fit(
+            jnp.asarray(feats), jnp.asarray(labels), checkpoint=checkpoint
+        )
+
+    # Incumbent: fit on the pre-drift world, served behind a router.
+    xa = _serve_requests(rng, n_rows)
+    feats_a = xa - mean0
+    pipe_inc = featurizer.then(fit_model(feats_a, feats_a @ t1))
+    cfg = kserve.ServeConfig(buckets=(1, 2, 4), max_wait_ms=2.0)
+    engine_inc = kserve.ServingEngine(
+        pipe_inc, np.zeros(16, np.float32), config=cfg,
+        label=f"chaos_refit_inc_{seed}",
+    )
+    ref = _serve_requests(rng, n_ref)
+    baseline = knum.OutputSketch.for_outputs(engine_inc.offline(ref)).record()
+
+    # Post-drift world: shifted requests, new truth, fresh training data.
+    xb = _serve_requests(rng, n_rows) + shift
+    feats_b = xb - mean0
+    labels_b = feats_b @ t2
+    # Big enough that the noise-fit candidate's holdout MSE dwarfs even a
+    # badly-wrong incumbent's — the rejection leg must be unambiguous.
+    labels_noise = rng.normal(size=labels_b.shape).astype(np.float32) * 50.0
+    hx = _serve_requests(rng, 64) + shift
+    hy = (hx - mean0) @ t2
+    shifted = _serve_requests(rng, n_shift) + shift
+    reqs_mid = _serve_requests(rng, n_req) + shift
+    reqs_post = _serve_requests(rng, n_req) + shift
+
+    # The OFFLINE refit oracle: same fresh data, fit outside the
+    # controller — post-swap served answers must be bit-equal to it.
+    pipe_offline = featurizer.then(fit_model(feats_b, labels_b))
+    offline_refit = np.asarray(pipe_offline(jnp.asarray(reqs_post)))
+
+    mode = {"fetch": "good"}
+
+    def fetch(digest):
+        if mode["fetch"] == "oom":
+            raise faults.resource_exhausted_error()
+        if mode["fetch"] == "noise":
+            return feats_b, labels_noise
+        return feats_b, labels_b
+
+    def quality(predict, x, y):
+        return -float(np.mean((np.asarray(predict(x)) - y) ** 2))
+
+    pm_dir = os.path.join(tmpdir, f"chaos_refit_{seed}_pm")
+    with ktelemetry._pm_lock:
+        ktelemetry._pm_counts.pop("serve_output_drift", None)
+        ktelemetry._pm_counts.pop("lifecycle_refit", None)
+    before = {
+        k: counters.get(k)
+        for k in (
+            "serve_output_drift", "refit_failed", "refit_rejected",
+            "lifecycle_refit", "drift_rearmed", "refit_suppressed",
+        )
+    }
+
+    def delta(kind):
+        return counters.get(kind) - before[kind]
+
+    router = kfrontend.ShapeRouter(
+        label=f"chaos_refit_{seed}",
+        config=kfrontend.RouterConfig(warm_threshold=1, retire_after_s=300.0),
+    )
+    os.environ["KEYSTONE_POSTMORTEM_DIR"] = pm_dir
+    ctl = None
+    try:
+        router.add_engine(engine_inc)
+        ctl = LifecycleController(
+            router,
+            workdir=os.path.join(tmpdir, f"chaos_refit_{seed}_wd"),
+            featurizer=featurizer,
+            fetch=fetch,
+            estimator=lambda: BlockLeastSquaresEstimator(
+                block_size=16, num_iter=1, lam=0.0
+            ),
+            assemble=lambda model: featurizer.then(model),
+            holdout=lambda: (hx, hy),
+            quality=quality,
+            example=np.zeros(16, np.float32),
+            label=f"chaos_refit_{seed}",
+            serve_config=cfg,
+            config=LifecycleConfig(cooldown_s=0.0, poll_interval_s=0.05),
+        )
+        with knum.monitored(True):
+            engine_inc.arm_drift_baseline(baseline)
+            # -- leg 0: the shifted mix trips the armed monitor ---------------
+            futs = [router.submit(r) for r in shifted]
+            mon = np.stack([np.asarray(f.result(30.0)) for f in futs])
+            if not np.array_equal(mon, engine_inc.offline(shifted)):
+                raise ChaosOracleError(
+                    "served answers under drift detection differ from the "
+                    "incumbent's offline apply"
+                )
+            if delta("serve_output_drift") < 1:
+                raise ChaosOracleError(
+                    "shifted request mix produced no counted "
+                    "serve_output_drift — the monitor missed the shift"
+                )
+            reason = ctl.check_signals()
+            if reason != "serve_output_drift":
+                raise ChaosOracleError(
+                    f"the lifecycle watcher did not see the drift trip "
+                    f"(check_signals -> {reason!r})"
+                )
+
+            def incumbent_still_serving(leg):
+                table = router.engines()
+                if table.get((16,)) != engine_inc.label:
+                    raise ChaosOracleError(
+                        f"{leg}: the failed cycle touched the routing table "
+                        f"({table}) — a half-swapped model is serving"
+                    )
+                probe = _serve_requests(rng, 3) + shift
+                got = np.stack(
+                    [
+                        np.asarray(f.result(30.0))
+                        for f in [router.submit(r) for r in probe]
+                    ]
+                )
+                if not np.array_equal(got, engine_inc.offline(probe)):
+                    raise ChaosOracleError(
+                        f"{leg}: post-fault answers differ from the "
+                        "incumbent's offline apply — silent wrong answers"
+                    )
+
+            # -- leg A: refit OOM degrades typed + counted --------------------
+            mode["fetch"] = "oom"
+            rec = ctl.run_refit(reason=reason)
+            if rec["outcome"] != "refit_failed" or delta("refit_failed") < 1:
+                raise ChaosOracleError(
+                    f"injected refit OOM was not a counted typed "
+                    f"degradation: {rec}"
+                )
+            incumbent_still_serving("refit OOM")
+
+            # -- leg B: a WORSE candidate is rejected, never swapped ----------
+            mode["fetch"] = "noise"
+            rec = ctl.run_refit(reason="operator")
+            if rec["outcome"] != "rejected" or delta("refit_rejected") < 1:
+                raise ChaosOracleError(
+                    f"a candidate refit worse than the incumbent was not "
+                    f"rejected+counted: {rec}"
+                )
+            incumbent_still_serving("validation rejection")
+
+            # -- leg C: a mid-swap kill degrades typed + counted --------------
+            mode["fetch"] = "good"
+            real_replace = router.replace_engine
+            failed_before = delta("refit_failed")
+            try:
+                def killed_replace(engine, **kw):
+                    raise kserve.ServingUnavailable("injected mid-swap kill")
+
+                router.replace_engine = killed_replace
+                rec = ctl.run_refit(reason="operator")
+            finally:
+                router.replace_engine = real_replace
+            if (
+                rec["outcome"] != "refit_failed"
+                or rec.get("phase") != "swap"
+                or delta("refit_failed") <= failed_before
+            ):
+                raise ChaosOracleError(
+                    f"a mid-swap kill was not a counted typed degradation "
+                    f"to the incumbent: {rec}"
+                )
+            incumbent_still_serving("mid-swap kill")
+
+            # -- leg D: the clean cycle lands, requests in flight -------------
+            ctl.config.cooldown_s = 300.0  # leg E exercises the storm guard
+            inflight_mid = []
+            real_execute = engine_inc._execute
+
+            def slow_execute(bucket, dev_batch):
+                # Stretch the incumbent's batches so the swap demonstrably
+                # straddles live requests (drain-after-unroute resolves
+                # them on the OLD engine — zero loss).
+                time.sleep(hold)
+                return real_execute(bucket, dev_batch)
+
+            def replace_with_traffic(engine, **kw):
+                inflight_mid.extend(router.submit(r) for r in reqs_mid)
+                return real_replace(engine, **kw)
+
+            try:
+                engine_inc._execute = slow_execute
+                router.replace_engine = replace_with_traffic
+                rec = ctl.run_refit(reason=reason)
+            finally:
+                engine_inc._execute = real_execute
+                router.replace_engine = real_replace
+            if rec["outcome"] != "swapped" or delta("lifecycle_refit") < 1:
+                raise ChaosOracleError(
+                    f"the clean drift->refit->swap cycle did not land "
+                    f"counted: {rec}"
+                )
+            if delta("drift_rearmed") < 1:
+                raise ChaosOracleError(
+                    "the swap landed but the drift monitor was not "
+                    "re-armed on the candidate's baseline"
+                )
+            dropped = 0
+            mid_answers = []
+            for f in inflight_mid:
+                try:
+                    mid_answers.append(np.asarray(f.result(60.0)))
+                except Exception:  # noqa: BLE001 — counted as a drop
+                    dropped += 1
+            if dropped:
+                raise ChaosOracleError(
+                    f"{dropped} request(s) in flight across the hot-swap "
+                    "were dropped — the swap opened a service gap"
+                )
+            if not np.array_equal(
+                np.stack(mid_answers), engine_inc.offline(reqs_mid)
+            ):
+                raise ChaosOracleError(
+                    "in-flight answers across the swap differ from the "
+                    "incumbent's offline apply"
+                )
+            engine_new = router.server_for((16,)).engine
+            if engine_new is engine_inc:
+                raise ChaosOracleError("the swap left the incumbent routed")
+            post = np.stack(
+                [
+                    np.asarray(f.result(30.0))
+                    for f in [router.submit(r) for r in reqs_post]
+                ]
+            )
+            if not np.array_equal(post, offline_refit):
+                raise ChaosOracleError(
+                    "post-swap answers differ from the offline refit — "
+                    "the lifecycle served a model that is not the refit"
+                )
+            dumps = _glob.glob(
+                os.path.join(pm_dir, "postmortem_lifecycle_refit_*.json")
+            )
+            if not dumps:
+                raise ChaosOracleError(
+                    "lifecycle_refit was counted but no flight-recorder "
+                    "postmortem was dumped — the swap left no evidence"
+                )
+
+            # -- leg E: the cooldown storm guard ------------------------------
+            rec = ctl.run_refit(reason="operator")
+            if (
+                rec["outcome"] != "suppressed"
+                or delta("refit_suppressed") < 1
+            ):
+                raise ChaosOracleError(
+                    f"a trip inside the cooldown was not a counted "
+                    f"suppression: {rec}"
+                )
+    finally:
+        if ctl is not None:
+            ctl.close()
+        router.close()
+        os.environ.pop("KEYSTONE_POSTMORTEM_DIR", None)
+        knum.reset_state()
+
+
 def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
     """Apply one schedule to the workload; returns the results dict (or
     raises).  Each branch is the minimal faithful injection for its
@@ -1854,6 +2202,10 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "host_loss":
         _host_loss_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "drift_refit":
+        _drift_refit_phase(fault, tmpdir, seed)
         return _run_workload(workload)
 
     if fault.kind == "stream_hang":

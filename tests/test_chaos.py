@@ -40,6 +40,7 @@ EXPECTED_COUNTER = {
     "output_drift": "serve_output_drift",
     "mesh_shrink": "mesh_reanchor",
     "host_loss": "host_reanchor",
+    "drift_refit": "lifecycle_refit",
 }
 
 
@@ -57,13 +58,13 @@ def _check(r):
 def test_chaos_schedule_mnist(seed, tmp_path):
     """Every tier-1 schedule runs TRACED and its trace is held to the
     never-silent bar (the ``chaos_run.py --trace`` invariant, extended
-    from the original 10 families to all 21): every counted fault appears
+    from the original 10 families to all 25): every counted fault appears
     as a kind-tagged ``fault`` instant, every typed error as a failed
     span or fault event."""
     trace_path = str(tmp_path / f"chaos_seed{seed}.json")
     r = chaos.run_schedule(
         seed, "mnist", tmpdir=str(tmp_path), trace_path=trace_path
-    )  # 24 families as of ISSUE 17 (host_loss)
+    )  # 25 families as of ISSUE 18 (drift_refit)
     _check(r)
     violations = chaos.verify_trace(trace_path, r)
     assert violations == [], {
@@ -136,6 +137,13 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # — zero dropped requests, every answer bit-equal to the offline
     # oracle
     assert "host_loss" in kinds
+    # Lifecycle coverage (ISSUE 18): a drifted served model must be
+    # detected, warm-refit, validated, and hot-swapped with zero dropped
+    # requests and post-swap answers bit-equal to an offline refit;
+    # injected refit OOM / validation rejection / mid-swap kill must each
+    # degrade typed+counted to the incumbent — never a silent wrong or
+    # missing answer
+    assert "drift_refit" in kinds
 
 
 def test_schedules_are_deterministic():

@@ -344,3 +344,136 @@ class TestConfig:
             frontend.RouterConfig(warm_threshold=0)
         with pytest.raises(ValueError):
             frontend.RouterConfig(mix_window_s=0)
+
+class TestReplaceEngine:
+    """Atomic per-shape engine replacement (ISSUE 18 satellite): the swap
+    is add-then-retire under ONE routing-table update, so a continuously
+    servable shape never answers a transient ``RetryLater`` and no
+    in-flight request is lost."""
+
+    @staticmethod
+    def _successor(shape, seed, label="frontend"):
+        """A replacement engine with DISTINCT weights (so old/new answers
+        are distinguishable) under the SAME shape label as
+        :func:`_make_engine` — exercising the same-label rename guard."""
+        shape = tuple(int(d) for d in shape)
+        srng = np.random.default_rng(seed)
+        w = jnp.asarray(srng.normal(size=shape).astype(np.float32))
+        b = jnp.asarray(srng.normal(size=shape).astype(np.float32))
+        pipe = FunctionTransformer(lambda x: jnp.maximum(x * w, b), name="toy2")
+        cfg = kserve.ServeConfig(buckets=(1, 2, 4), max_wait_ms=2.0)
+        return kserve.ServingEngine(
+            pipe, np.zeros(shape, np.float32), config=cfg,
+            label=frontend.shape_label(label, shape),
+        )
+
+    def test_swap_is_atomic_with_zero_request_loss(self, rng):
+        e_old = _make_engine((16,))
+        e_new = self._successor((16,), seed=99)
+        reqs = _reqs(rng, 6, (16,))
+        with _router() as router:
+            router.add_engine(e_old)
+            # Stretch the incumbent's batches so the swap genuinely
+            # straddles in-flight work.
+            real_exec = e_old._execute
+            def slow_execute(bucket, dev):
+                time.sleep(0.05)
+                return real_exec(bucket, dev)
+            e_old._execute = slow_execute
+            inflight = [router.submit(r) for r in reqs]
+            # Probe the routing table at the most hostile instant: from
+            # INSIDE the incumbent's retirement (table already flipped to
+            # the successor, drain not yet finished).  The probe must
+            # route — a retire-then-add sequence would RetryLater here.
+            mid = {}
+            real_retire = router._retire_entry
+            def retire_probe(entry, why):
+                mid["fut"] = router.submit(reqs[0])
+                real_retire(entry, why=why)
+            router._retire_entry = retire_probe
+            try:
+                key = router.replace_engine(e_new, why="test swap")
+            finally:
+                router._retire_entry = real_retire
+                e_old._execute = real_exec
+            assert key == (16,)
+            # Every pre-swap future resolved on the OLD engine, bit-equal
+            # (drained, not dropped).
+            old_ans = np.stack([f.result(30.0) for f in inflight])
+            assert np.array_equal(old_ans, e_old.offline(reqs))
+            # The mid-retirement probe answered on the NEW engine.
+            probe = np.asarray(mid["fut"].result(30.0))
+            assert np.array_equal(probe, e_new.offline(reqs[:1])[0])
+            # Post-swap traffic routes to the successor.
+            post = np.stack([router.submit(r).result(30.0) for r in reqs])
+            assert np.array_equal(post, e_new.offline(reqs))
+            assert router.stats.replaces == 1
+            assert router.stats.retires == 1
+            # No backpressure / miss for a shape that never stopped being
+            # servable.
+            assert router.stats.rejected == 0
+            assert router.stats.no_route == 0
+            assert router.stats.misses == 0
+
+    def test_same_label_successor_is_renamed(self):
+        """SLO trackers and drift monitors unregister BY LABEL at
+        retirement: a same-label successor must be renamed before its
+        server registers, or the incumbent's retirement would strip the
+        successor's telemetry."""
+        e_old = _make_engine((8,))
+        e_new = self._successor((8,), seed=41)
+        assert e_new.label == e_old.label
+        with _router() as router:
+            router.add_engine(e_old)
+            router.replace_engine(e_new, why="same-label swap")
+            assert e_new.label == f"{e_old.label}@swap"
+            assert router.engines()[(8,)] == e_new.label
+            # The successor's SLO tracker survived the incumbent's
+            # label-keyed unregistration.
+            assert e_new.label in telemetry.slo_summaries()
+
+    def test_mix_accounting_carries_over(self, rng):
+        """``routes``/``last_routed`` carry across the swap so the
+        idle-retire clock does not restart on a replacement."""
+        clock = FakeClock()
+        e_old = _make_engine((16,))
+        e_new = self._successor((16,), seed=77)
+        with _router(clock=clock) as router:
+            router.add_engine(e_old)
+            for r in _reqs(rng, 5, (16,)):
+                router.submit(r).result(30.0)
+            with router._lock:
+                before = router._engines[(16,)].routes
+            assert before == 5
+            router.replace_engine(e_new, why="carry-over check")
+            with router._lock:
+                entry = router._engines[(16,)]
+                assert entry.routes == before
+                assert entry.engine is e_new
+
+    def test_replace_without_incumbent_degrades_to_add(self, rng):
+        e = _make_engine((16,))
+        with _router() as router:
+            key = router.replace_engine(e, why="first deploy")
+            assert key == (16,)
+            assert router.stats.replaces == 0
+            assert router.stats.retires == 0
+            r = _reqs(rng, 3, (16,))
+            ans = np.stack([router.submit(x).result(30.0) for x in r])
+            assert np.array_equal(ans, e.offline(r))
+
+    def test_replace_on_closed_router_is_typed(self):
+        router = _router()
+        router.add_engine(_make_engine((16,)))
+        router.close()
+        with pytest.raises(kserve.ServingUnavailable):
+            router.replace_engine(self._successor((16,), seed=5))
+
+    def test_duplicate_add_still_rejected_after_replace(self):
+        """replace_engine is the ONLY path that overwrites a live shape —
+        add_engine keeps its collision guard."""
+        with _router() as router:
+            router.add_engine(_make_engine((16,)))
+            router.replace_engine(self._successor((16,), seed=13))
+            with pytest.raises(ValueError, match="already has a live engine"):
+                router.add_engine(_make_engine((16,)))

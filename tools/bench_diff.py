@@ -137,6 +137,16 @@ DEFAULT_METRICS: tuple = (
     ("extra_metrics.multihost.reshard_wall_s", "lower", 0.50),
     ("extra_metrics.multihost.host_loss.reanchor_wall_s", "lower", 0.50),
     ("extra_metrics.multihost.host_loss.dropped_requests", "lower", 0.00),
+    # ISSUE 18: closed-loop model lifecycle — the drift→refit→validate→
+    # swap drill's walls must not creep across rounds (a slower warm
+    # refit or hot-swap means the serving fleet spends longer answering
+    # from a stale model), and the atomic hot-swap must NEVER drop a
+    # request (zero stays zero: any nonzero candidate against the zero
+    # base is a regression, see compare()).
+    ("extra_metrics.lifecycle.refit_wall_s", "lower", 0.50),
+    ("extra_metrics.lifecycle.swap_wall_s", "lower", 0.50),
+    ("extra_metrics.lifecycle.drift_to_healthy_wall_s", "lower", 0.50),
+    ("extra_metrics.lifecycle.dropped_requests", "lower", 0.00),
 )
 
 

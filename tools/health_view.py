@@ -14,11 +14,19 @@ Accepts (auto-detected, first match wins):
 * a workload results / serving record holding ``numerics`` /
   ``output_drift`` / ``conditioning`` keys.
 
+Lifecycle-aware (ISSUE 18): any artifact carrying a
+``lifecycle:<label>`` statusz section, a bench round's
+``extra_metrics.lifecycle``, or a serve_bench ``--drift-refit`` drill
+record additionally renders a ``== model lifecycle ==`` table — the
+controller's state (IDLE/REFITTING/VALIDATING/SWAPPING/COOLDOWN),
+generation, cooldown, and the last cycle's outcome + walls.
+
 Usage:
     python tools/health_view.py postmortem_serve_output_drift_123_0.json
     python tools/health_view.py BENCH_r06.json
 
-Exit status: 0 = rendered, 2 = no numerics surface found in the document.
+Exit status: 0 = rendered, 2 = neither a numerics nor a lifecycle
+surface found in the document.
 """
 
 from __future__ import annotations
@@ -99,6 +107,71 @@ def extract_numerics(doc) -> dict:
                 out["conditioning"] = rep["conditioning"]
                 break
     return {k: v for k, v in out.items() if v}
+
+
+def extract_lifecycle(doc) -> dict:
+    """Every lifecycle-controller section in ``doc`` as
+    ``{label: record}`` — statusz ``lifecycle:<label>`` providers, a
+    bench round's ``extra_metrics.lifecycle`` (whose controller record
+    rides in ``statusz``), a serve_bench ``--drift-refit`` drill, or a
+    bare ``lifecycle`` key."""
+    if not isinstance(doc, dict):
+        return {}
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    out: dict = {}
+
+    def adopt(rec, extra=None):
+        if isinstance(rec, dict) and "state" in rec:
+            merged = dict(rec)
+            if extra:
+                merged.update(
+                    {k: v for k, v in extra.items() if k not in merged}
+                )
+            out.setdefault(merged.get("label", "lifecycle"), merged)
+
+    providers = doc.get("providers")
+    if isinstance(providers, dict):
+        for name, rec in providers.items():
+            if str(name).startswith("lifecycle:"):
+                adopt(rec)
+    ex = doc.get("extra_metrics")
+    if isinstance(ex, dict) and isinstance(ex.get("lifecycle"), dict):
+        sec = ex["lifecycle"]
+        adopt(sec.get("statusz"), extra=sec)
+    drill = doc.get("drill")
+    if isinstance(drill, dict):
+        adopt(drill.get("lifecycle"), extra=drill)
+    adopt(doc.get("lifecycle"))
+    return out
+
+
+def render_lifecycle(sections: dict) -> str:
+    """The ``== model lifecycle ==`` table (empty string when ``sections``
+    is empty)."""
+    if not sections:
+        return ""
+    rows = []
+    for label in sorted(sections):
+        s = sections[label]
+        last = s.get("last_cycle") or {}
+        rows.append([
+            label,
+            _fmt(s.get("state")),
+            _fmt(s.get("generation")),
+            _fmt(s.get("cooldown_remaining_s")),
+            _fmt(s.get("watching")),
+            _fmt(last.get("outcome") or s.get("outcome")),
+            _fmt(last.get("reason") or s.get("tripped")),
+            _fmt(last.get("refit_wall_s", s.get("refit_wall_s"))),
+            _fmt(last.get("swap_wall_s", s.get("swap_wall_s"))),
+            _fmt(s.get("dropped_requests")),
+        ])
+    return "== model lifecycle ==\n" + _table(
+        ["controller", "state", "gen", "cooldown_s", "watching",
+         "last outcome", "reason", "refit_s", "swap_s", "dropped"],
+        rows,
+    )
 
 
 def render(numerics: dict) -> str:
@@ -198,14 +271,16 @@ def main(argv=None) -> int:
         print(f"health_view: cannot read {a.record}: {e}", file=sys.stderr)
         return 2
     numerics = extract_numerics(doc)
-    if not numerics:
+    lifecycle = extract_lifecycle(doc)
+    if not numerics and not lifecycle:
         print(
-            f"health_view: no numerics surface in {a.record} — was the run "
-            "monitored (KEYSTONE_NUMERICS=1)?",
+            f"health_view: no numerics or lifecycle surface in {a.record} "
+            "— was the run monitored (KEYSTONE_NUMERICS=1)?",
             file=sys.stderr,
         )
         return 2
-    print(render(numerics))
+    parts = [p for p in (render(numerics), render_lifecycle(lifecycle)) if p]
+    print("\n\n".join(parts))
     return 0
 
 

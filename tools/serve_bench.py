@@ -32,12 +32,21 @@ host-local ShapeRouter behind a WireServer, fronted by a
 additionally SIGKILLs rank R mid-flight and proves the survivors
 re-anchor with zero lost requests.
 
+``--drift-refit`` runs the closed-lifecycle drill (ISSUE 18): a shifted
+request mix trips the armed drift monitor of a served incumbent, the
+:class:`~keystone_tpu.core.lifecycle.LifecycleController` warm-refits on
+fresh data, validates, and hot-swaps the router's engine with requests
+in flight — the record carries ``drift_to_healthy_wall_s``,
+``refit_wall_s``/``swap_wall_s``, and ``dropped_requests`` (pinned 0 by
+tools/bench_diff.py; exit 1 on any drop or a cycle that fails to land).
+
 Usage:
     python tools/serve_bench.py                        # in-process
     python tools/serve_bench.py --wire --clients 4     # real sockets
     python tools/serve_bench.py --wire --shift         # + mix-shift replay
     python tools/serve_bench.py --hosts 2              # multi-host fleet
     python tools/serve_bench.py --hosts 3 --kill-host 2  # + host loss
+    python tools/serve_bench.py --drift-refit          # lifecycle drill
 """
 
 from __future__ import annotations
@@ -291,6 +300,202 @@ def run_shift(router, ws, shapes, timeout) -> dict:
     return out
 
 
+def drift_refit_drill(tmpdir, *, requests=24, seed=0, timeout=60.0) -> dict:
+    """The closed model-lifecycle drill (ISSUE 18), importable by
+    bench.py's ``extra_metrics.lifecycle`` section: an incumbent fit on
+    pre-drift truth serves an armed router; the request mix shifts (new
+    truth), the drift monitor trips, and the
+    :class:`~keystone_tpu.core.lifecycle.LifecycleController` runs one
+    full cycle — warm refit on fresh data, holdout validation, atomic
+    hot-swap — while a pump thread keeps requests in flight across the
+    swap.  The record carries the walls bench_diff regresses on
+    (``drift_to_healthy_wall_s``, ``refit_wall_s``, ``swap_wall_s``),
+    ``dropped_requests`` (must stay 0), the post-swap bit-equality
+    verdict, and the controller's ``lifecycle:<label>`` statusz section.
+    """
+    import jax.numpy as jnp
+
+    from keystone_tpu.core import frontend as kfrontend
+    from keystone_tpu.core import numerics as knum
+    from keystone_tpu.core import serve as kserve
+    from keystone_tpu.core.lifecycle import LifecycleConfig, LifecycleController
+    from keystone_tpu.ops.stats import StandardScalerModel
+    from keystone_tpu.solvers.block import BlockLeastSquaresEstimator
+
+    rng = np.random.default_rng(seed)
+    d, k = 16, 4
+    mean0 = rng.normal(size=(d,)).astype(np.float32)
+    t1 = rng.normal(size=(d, k)).astype(np.float32)
+    t2 = rng.normal(size=(d, k)).astype(np.float32)
+    featurizer = StandardScalerModel(jnp.asarray(mean0), None)
+    shift = np.zeros(d, np.float32)
+    shift[int(np.argmax(np.abs(t1).sum(axis=1)))] = 6.0
+
+    def fit(feats, labels):
+        est = BlockLeastSquaresEstimator(block_size=16, num_iter=1, lam=0.0)
+        return est.fit(jnp.asarray(feats), jnp.asarray(labels))
+
+    # Pre-drift world: the incumbent's truth is (x - mean0) @ t1.
+    xa = rng.normal(size=(128, d)).astype(np.float32)
+    feats_a = xa - mean0
+    pipe_inc = featurizer.then(fit(feats_a, feats_a @ t1))
+    cfg = kserve.ServeConfig(buckets=(1, 2, 4), max_wait_ms=2.0)
+    engine = kserve.ServingEngine(
+        pipe_inc, np.zeros(d, np.float32), config=cfg, label="lifedrill_inc"
+    )
+    baseline = knum.OutputSketch.for_outputs(
+        engine.offline(rng.normal(size=(64, d)).astype(np.float32))
+    ).record()
+
+    # Post-drift world: shifted requests, new truth (x - mean0) @ t2.
+    xb = rng.normal(size=(128, d)).astype(np.float32) + shift
+    feats_b = xb - mean0
+    labels_b = feats_b @ t2
+    hx = rng.normal(size=(64, d)).astype(np.float32) + shift
+    hy = (hx - mean0) @ t2
+    shifted = rng.normal(size=(max(48, requests), d)).astype(np.float32) + shift
+    reqs = rng.normal(size=(requests, d)).astype(np.float32) + shift
+
+    router = kfrontend.ShapeRouter(
+        label="lifedrill",
+        config=kfrontend.RouterConfig(warm_threshold=1, retire_after_s=300.0),
+    )
+    record: dict = {"requests": int(requests)}
+    dropped = [0]
+    pumped = [0]
+    ctl = None
+    try:
+        router.add_engine(engine)
+        ctl = LifecycleController(
+            router,
+            workdir=os.path.join(tmpdir, "lifedrill_wd"),
+            featurizer=featurizer,
+            fetch=lambda digest: (feats_b, labels_b),
+            estimator=lambda: BlockLeastSquaresEstimator(
+                block_size=16, num_iter=1, lam=0.0
+            ),
+            assemble=lambda model: featurizer.then(model),
+            holdout=lambda: (hx, hy),
+            quality=lambda predict, x, y: -float(
+                np.mean((np.asarray(predict(x)) - y) ** 2)
+            ),
+            example=np.zeros(d, np.float32),
+            label="lifedrill",
+            serve_config=cfg,
+            config=LifecycleConfig(cooldown_s=0.0),
+        )
+        with knum.monitored(True):
+            engine.arm_drift_baseline(baseline)
+            t_drift = time.perf_counter()
+            for f in [router.submit(r) for r in shifted]:
+                f.result(timeout)
+            tripped = ctl.check_signals()
+            record["tripped"] = tripped
+            # Keep requests in flight ACROSS the swap: the drill's
+            # zero-drop claim is about live traffic, not a quiesced
+            # router.
+            stop = threading.Event()
+
+            def pump():
+                i = 0
+                while not stop.is_set():
+                    try:
+                        router.submit(reqs[i % len(reqs)]).result(timeout)
+                    except Exception:  # noqa: BLE001 — any loss is a drop
+                        dropped[0] += 1
+                    pumped[0] += 1
+                    i += 1
+
+            pump_thread = threading.Thread(
+                target=pump, name="lifedrill-pump", daemon=True
+            )
+            pump_thread.start()
+            try:
+                cycle = ctl.run_refit(reason=tripped or "operator")
+            finally:
+                stop.set()
+                pump_thread.join(timeout)
+            record["drift_to_healthy_wall_s"] = round(
+                time.perf_counter() - t_drift, 6
+            )
+        record["cycle"] = cycle
+        for key in ("refit_wall_s", "validate_wall_s", "swap_wall_s",
+                    "total_wall_s"):
+            record[key] = cycle.get(key)
+        # Post-swap answers must be bit-equal to the NEW engine's own
+        # eager oracle (the refit pipeline).
+        new_engine = router.server_for((d,)).engine
+        post = np.stack(
+            [router.submit(r).result(timeout) for r in reqs]
+        )
+        record["swapped_engine"] = new_engine.label
+        record["post_swap_bit_equal"] = bool(
+            np.array_equal(post, new_engine.offline(reqs))
+        )
+        record["in_flight_across_swap"] = int(pumped[0])
+        record["dropped_requests"] = int(dropped[0])
+        record["lifecycle"] = ctl.record()
+        record["ok"] = bool(
+            tripped == "serve_output_drift"
+            and cycle.get("outcome") == "swapped"
+            and new_engine is not engine
+            and record["post_swap_bit_equal"]
+            and dropped[0] == 0
+        )
+        return record
+    finally:
+        if ctl is not None:
+            ctl.close()
+        router.close()
+
+
+def run_drift_refit(a) -> int:
+    """--drift-refit: the lifecycle drill as a CLI record (JSON first
+    line, bench.py convention; exit 1 unless the cycle landed with zero
+    dropped requests and bit-equal post-swap answers)."""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="serve_bench_lifecycle_")
+    t0 = time.perf_counter()
+    try:
+        drill = drift_refit_drill(
+            tmp, requests=a.requests, timeout=a.timeout
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    record = {
+        "metric": "serve_bench",
+        "mode": "drift_refit",
+        "drill": drill,
+        # Top-level copies for the regression observatory's dotted paths.
+        "drift_to_healthy_wall_s": drill.get("drift_to_healthy_wall_s"),
+        "refit_wall_s": drill.get("refit_wall_s"),
+        "swap_wall_s": drill.get("swap_wall_s"),
+        "dropped_requests": drill.get("dropped_requests"),
+        "ok": drill.get("ok", False),
+        "seconds": round(time.perf_counter() - t0, 3),
+    }
+    print(json.dumps(record), flush=True)
+    cyc = drill.get("cycle", {})
+    print(
+        f"# lifecycle: tripped on {drill.get('tripped')}, cycle outcome "
+        f"{cyc.get('outcome')} (g{cyc.get('generation')}), engine "
+        f"{drill.get('swapped_engine')}"
+    )
+    print(
+        f"# walls: drift->healthy {drill.get('drift_to_healthy_wall_s')}s "
+        f"(refit {drill.get('refit_wall_s')}s, validate "
+        f"{drill.get('validate_wall_s')}s, swap {drill.get('swap_wall_s')}s)"
+    )
+    print(
+        f"# traffic: {drill.get('in_flight_across_swap')} request(s) pumped "
+        f"across the swap, {drill.get('dropped_requests')} dropped, "
+        f"post-swap bit-equal: {drill.get('post_swap_bit_equal')}"
+    )
+    return 0 if record["ok"] else 1
+
+
 def run_hosts(a) -> int:
     """--hosts N (ISSUE 17): spawn N REAL serve-host worker processes
     (keystone_tpu.workloads.multihost serve-host, toy scaler mode), front
@@ -491,9 +696,17 @@ def main(argv=None) -> int:
         "re-form the group and re-anchor while the fleet reissues; zero "
         "lost requests or exit 1",
     )
+    p.add_argument(
+        "--drift-refit", action="store_true",
+        help="closed-lifecycle drill (ISSUE 18): trip the drift monitor "
+        "with a shifted mix, warm-refit, validate, hot-swap with requests "
+        "in flight — zero dropped requests or exit 1",
+    )
     p.add_argument("--timeout", type=float, default=120.0)
     a = p.parse_args(argv)
 
+    if a.drift_refit:
+        return run_drift_refit(a)
     if a.kill_host is not None and a.hosts is None:
         p.error("--kill-host requires --hosts")
     if a.hosts is not None:
