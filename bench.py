@@ -1628,11 +1628,48 @@ def _decode_path_breakdown(
                 dev_e2e / max(1e-9, min(dev_dec, dev_feat)), 3
             ),
             "entropy_decoded": dev_stats.entropy_decoded,
+            # the scan hot-loop backend this pass ACTUALLY ran — "native"
+            # (ops.native_entropy) or "python" (the portable fallback)
+            "entropy_backend": dev_stats.entropy_backend,
             "fallbacks": dev_stats.device_fallbacks,
             "coeff_bytes": dev_stats.coeff_bytes,
             "golden_max_abs_vs_host": parity,
             "within_golden_tolerance": bool(parity <= GOLDEN_MAX_ABS),
         }
+
+        # -- entropy hot-loop backends (ISSUE 19) -----------------------------
+        # Direct entropy_decode rates over the SAME corpus members, native
+        # vs Python, single-threaded — the isolated cost of the scan loop
+        # the backends swap (the e2e device rate above shows what the
+        # swap buys the stream).  Native numbers are recorded only when
+        # the library actually built; the leg always records which
+        # backend the live device path resolved to.
+        from keystone_tpu.loaders.image_loaders import _iter_tar_members
+        from keystone_tpu.ops import jpeg_device as _jd
+        from keystone_tpu.ops import native_entropy as _ne
+
+        members = [d for _nm, d in _iter_tar_members(tar_path)]
+
+        def entropy_rate(backend):
+            _jd.entropy_decode(members[0], backend=backend)  # warm LUT cache
+            t0 = time.perf_counter()
+            for d in members:
+                _jd.entropy_decode(d, backend=backend)
+            return n / (time.perf_counter() - t0)
+
+        py_rate = entropy_rate("python")
+        entropy_leg = {
+            "images": n,
+            "python_images_per_sec": round(py_rate, 2),
+            "backend_live": _jd.entropy_backend(),
+            "e2e_device_images_per_sec": round(dev_e2e, 2),
+            "e2e_overlap_efficiency": out["device"]["overlap_efficiency"],
+        }
+        if _ne.available():
+            nat_rate = entropy_rate("native")
+            entropy_leg["native_images_per_sec"] = round(nat_rate, 2)
+            entropy_leg["speedup"] = round(nat_rate / py_rate, 3)
+        out["entropy_native"] = entropy_leg
 
         # -- warm device-format snapshot (pure DMA) ---------------------------
         # cold pass (host decode + device-format tee), untimed

@@ -872,6 +872,7 @@ class StreamStats:
     snapshot_chunks_written: int = 0  #: chunks teed into a snapshot writer
     worker_respawns: int = 0  #: process-backend decode workers respawned
     entropy_decoded: int = 0  #: images entropy-decoded (device decode mode)
+    entropy_backend: str = ""  #: scan hot-loop backend ("native"/"python")
     entropy_corrupt: int = 0  #: typed+counted corrupt-scan skips
     device_fallbacks: int = 0  #: JPEGs routed to host decode (counted per reason)
     coeff_bytes: int = 0  #: coefficient payload bytes carried by the ring
@@ -1137,9 +1138,11 @@ class IngestStream:
         inference.  The module attribute is resolved at call time (the
         chaos harness patches ``image_loaders.decode_image``)."""
         if self._device_decode:
-            # Entropy-only pass: always the thread pool (the pass is the
-            # LIGHT half of the decode; the heavy math runs on-device) —
-            # a process backend setting governs the host-pixel path only.
+            # Entropy-only pass: always the thread pool (the native scan
+            # loop releases the GIL per call so the pool scales across
+            # cores; the pure-Python fallback stays the LIGHT half of the
+            # decode — the heavy math runs on-device).  A process backend
+            # setting governs the host-pixel path only.
             pool = self._ensure_thread_pool()
             if not trace.enabled():
                 return pool.submit(_entropy_decode_task, data)
@@ -1385,6 +1388,16 @@ class IngestStream:
             and self._writer is None
             and skip_chunks == 0
         )
+        if self._device_decode:
+            # Same prewarm contract for the entropy hot loop: build/load
+            # the native scan decoder (ops/native_entropy) before the
+            # entropy pool spins up, and record which backend this pass
+            # will run.  Unavailability degrades to the pure-Python pass
+            # counted native_entropy_unavailable — bit-equal stream,
+            # lower throughput, never a crash.
+            from ..ops import jpeg_device as _jd
+
+            self.stats.entropy_backend = _jd.entropy_backend()
         # shape -> (ordinals, names, images); insertion-ordered so the
         # end-of-stream flush of partial buckets is deterministic.
         buckets: dict = {}
@@ -1651,6 +1664,10 @@ class IngestStream:
         # device-snapshot acceptance check reads these (all zero on a
         # pure-DMA epoch except the dma gauge).
         m.gauge("ingest_entropy_decoded", self.stats.entropy_decoded)
+        m.gauge(
+            "ingest_entropy_native",
+            1 if self.stats.entropy_backend == "native" else 0,
+        )
         m.gauge("ingest_coeff_bytes", self.stats.coeff_bytes)
         m.gauge("ingest_device_fallbacks", self.stats.device_fallbacks)
         m.gauge("ingest_snapshot_dma_bytes", self.stats.snapshot_dma_bytes)

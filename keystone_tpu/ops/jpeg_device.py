@@ -45,9 +45,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 import os
 
 import numpy as np
+
+from . import native_entropy
+
+_logger = logging.getLogger(__name__)
 
 #: Golden-parity tolerance vs the host (libjpeg/PIL) decoder, in 8-bit
 #: sample levels.  Budget: libjpeg's fixed-point ``jpeg_idct_islow`` is
@@ -66,6 +71,13 @@ GOLDEN_MEAN_ABS = 1.0
 #: backends, jnp elsewhere (interpret mode is a correctness oracle, not a
 #: fast path — tier-1 asserts the two bit-equal).
 PALLAS_IDCT_ENV = "KEYSTONE_PALLAS_IDCT"
+
+#: ``KEYSTONE_NATIVE_ENTROPY``: ``0`` forces the pure-Python entropy pass;
+#: unset/anything else lazy-builds the native loop (ops/native_entropy)
+#: and degrades to Python counted when the toolchain is absent.  Both
+#: passes are bit-identical over the supported subset (tier-1 asserts it
+#: whenever the toolchain is available).
+NATIVE_ENTROPY_ENV = native_entropy.NATIVE_ENTROPY_ENV
 
 def _zigzag_order() -> np.ndarray:
     """zigzag scan position -> natural (row-major) position within the
@@ -322,6 +334,93 @@ def _decode_scan(
         )
 
 
+_native_fallback_logged = False
+
+
+def _run_scan(
+    segments, planes, mcu_blocks, ncomp, mcus_x, total_mcus, interval,
+    backend,
+):
+    """Backend dispatch for the scan hot loop — returns the backend that
+    actually ran (``"native"`` / ``"python"``).
+
+    ``backend=None`` (production) prefers the native loop when the
+    ``KEYSTONE_NATIVE_ENTROPY`` gate allows it and the library builds,
+    and otherwise runs the pure-Python pass — bit-equal by contract.  An
+    UNEXPECTED native failure (not a typed corrupt-stream error) degrades
+    this one image to the Python pass, counted ``native_entropy_fallback``
+    — never a crash, never a silent difference.  Explicit ``"native"`` /
+    ``"python"`` pin a backend for tests and benches; a pinned native
+    backend raises rather than degrade, so parity harnesses cannot
+    silently compare Python against itself.
+
+    ``native_entropy.decode_scan`` is resolved as a module attribute at
+    call time so the chaos harness can inject failures at the boundary.
+    """
+    if backend == "python":
+        _decode_scan(
+            segments, planes, mcu_blocks, ncomp, mcus_x, total_mcus,
+            interval,
+        )
+        return "python"
+    if backend == "native":
+        if not native_entropy.decode_scan(
+            segments, planes, mcu_blocks, ncomp, mcus_x, total_mcus,
+            interval,
+        ):
+            raise RuntimeError(
+                "entropy backend pinned to 'native' but the native "
+                "library is unavailable (check g++ / "
+                f"{NATIVE_ENTROPY_ENV})"
+            )
+        return "native"
+    if backend is not None:
+        raise ValueError(f"unknown entropy backend {backend!r}")
+    if native_entropy.enabled():
+        try:
+            if native_entropy.decode_scan(
+                segments, planes, mcu_blocks, ncomp, mcus_x, total_mcus,
+                interval,
+            ):
+                return "native"
+        except JpegEntropyCorrupt:
+            raise  # typed classification — identical to the Python pass
+        except Exception as exc:  # noqa: BLE001 — degrade, never crash
+            global _native_fallback_logged
+            if not _native_fallback_logged:
+                _native_fallback_logged = True
+                _logger.warning(
+                    "native entropy decode failed (%s: %s); this image "
+                    "degrades to the pure-Python pass (counted "
+                    "native_entropy_fallback; logged once)",
+                    type(exc).__name__, exc,
+                )
+            try:
+                from ..core.resilience import counters
+
+                counters.record(
+                    "native_entropy_fallback",
+                    f"{type(exc).__name__}: {exc}",
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            # the native call may have written a partial image before
+            # failing — re-zero so the Python re-decode starts clean
+            for p in planes:
+                p[...] = 0
+    _decode_scan(
+        segments, planes, mcu_blocks, ncomp, mcus_x, total_mcus, interval
+    )
+    return "python"
+
+
+def entropy_backend() -> str:
+    """The backend the auto dispatch would pick right now (``"native"`` /
+    ``"python"``) — for bench records and ingest telemetry.  Triggers the
+    lazy native build, so call it from setup paths, not per image."""
+    return "native" if native_entropy.available() else "python"
+
+
 def _u16(data: bytes, i: int) -> int:
     return (data[i] << 8) | data[i + 1]
 
@@ -520,12 +619,17 @@ def _split_scan(data: bytes, start: int) -> list[bytes]:
     return out
 
 
-def entropy_decode(data: bytes) -> CoeffImage:
+def entropy_decode(data: bytes, *, backend: str | None = None) -> CoeffImage:
     """Baseline-JPEG bytes -> :class:`CoeffImage` (host entropy pass only).
 
     Raises :class:`JpegDecodeUnsupported` (typed fallback routing) for
     streams outside the claimed subset and :class:`JpegEntropyCorrupt`
-    (typed counted skip) for damaged scans."""
+    (typed counted skip) for damaged scans.
+
+    ``backend`` pins the scan hot loop: ``"native"`` (the lazily-built C
+    loop, raises if unbuildable), ``"python"`` (the portable pass), or
+    ``None`` — native when available, Python otherwise, bit-identical
+    output either way (see :func:`_run_scan`)."""
     f = _parse_headers(data)
     ncomp = len(f.comps)
     hmax = max(c[1] for c in f.comps)
@@ -572,9 +676,9 @@ def entropy_decode(data: bytes) -> CoeffImage:
                 mcu_blocks.append(
                     (ci, v, h, by, bx, f.huff_dc[td], f.huff_ac[ta])
                 )
-    _decode_scan(
+    _run_scan(
         segments[:expected_segments], planes, mcu_blocks, ncomp,
-        mcus_x, total_mcus, interval,
+        mcus_x, total_mcus, interval, backend,
     )
 
     geom = JpegGeometry(

@@ -95,6 +95,16 @@ draws its parameters — fully deterministic):
   batch surviving, and the streamed features equal a fault-free
   device-decode stream over the survivors bit-for-bit — never silent
   wrong pixels.
+* ``native_entropy`` — the NATIVE entropy-decode backend
+  (ops.native_entropy, the C port of the scan hot loop) under the same
+  damage and under its own failure: corrupt-scan members through the
+  native-preferred device stream are the SAME typed counted skips
+  (``jpeg_corrupt_entropy``) with survivor features bit-equal to a
+  fault-free FORCED-PYTHON stream (the portable baseline every backend
+  must bit-match), and a mid-stream UNEXPECTED native failure degrades
+  that one image to the Python pass counted
+  (``native_entropy_fallback``) with the stream still bit-equal — never
+  a crash, never a silent difference between backends.
 * ``profiler_crash`` — the device cost-attribution layer's HBM watermark
   sampler thread (core.profiler) is killed MID-RUN by an injected stats
   failure: the crash is a counted degradation (``profiler_sampler_crash``),
@@ -208,6 +218,7 @@ FAMILIES = (
     "mesh_shrink",
     "host_loss",
     "drift_refit",
+    "native_entropy",
 )
 
 #: The serving-path families (core.serve / core.frontend / core.wire),
@@ -223,8 +234,8 @@ SERVE_FAMILIES = (
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(25))
-FULL_SEEDS = tuple(range(48))
+TIER1_SEEDS = tuple(range(26))
+FULL_SEEDS = tuple(range(52))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -390,6 +401,28 @@ def make_schedule(seed: int) -> Fault:
                 "corrupt": corrupt,
                 "batch": 4,
                 "mode": ("truncate", "marker")[int(rng.integers(0, 2))],
+            },
+        )
+    if kind == "native_entropy":
+        k = int(rng.integers(1, 3))
+        corrupt = tuple(  # strictly mid-stream members
+            sorted(
+                int(i)
+                for i in rng.choice(
+                    np.arange(1, _N_STREAM_IMAGES - 1), k, replace=False
+                )
+            )
+        )
+        return Fault(
+            kind,
+            {
+                "corrupt": corrupt,
+                "batch": 4,
+                "mode": ("truncate", "marker")[int(rng.integers(0, 2))],
+                # which decode_scan call the injected native failure hits —
+                # <= 8 so it always lands inside the survivor stream
+                # (>= _N_STREAM_IMAGES - 2 survivors)
+                "fail_at": int(rng.integers(1, 9)),
             },
         )
     if kind == "profiler_crash":
@@ -792,6 +825,125 @@ def _jpeg_corrupt_entropy_phase(fault: Fault, tmpdir: str, seed: int) -> None:
             "device-decoded features under entropy corruption differ "
             "from the fault-free device stream on the surviving images"
         )
+
+
+def _native_entropy_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """The native entropy backend (ops.native_entropy) held to the
+    backend-indistinguishability bar, in two legs:
+
+    1. a corrupt-scan member through the NATIVE-preferred device stream
+       is the same typed counted skip (``jpeg_corrupt_entropy``) and the
+       survivors are BIT-equal to a fault-free FORCED-PYTHON stream —
+       the portable baseline every backend must bit-match;
+    2. an UNEXPECTED native failure mid-stream (decode_scan raises on
+       call ``fail_at``) degrades that one image to the Python pass
+       counted ``native_entropy_fallback`` with the stream still
+       bit-equal — never a crash.
+
+    Both legs inject at the ``native_entropy.decode_scan`` boundary the
+    dispatch resolves at call time, so the family exercises the
+    degradation contract even on hosts where the library cannot build
+    (there decode_scan returns False and leg 1 runs the Python pass —
+    still bit-equal by definition)."""
+    from keystone_tpu.ops import native_entropy as ne
+
+    rng = np.random.default_rng(seed)
+    corrupt = tuple(fault.params["corrupt"])
+    batch = int(fault.params["batch"])
+    mode = fault.params["mode"]
+    fail_at = int(fault.params["fail_at"])
+    tar_bad = os.path.join(tmpdir, f"chaos_native_{seed}.tar")
+    names = faults.make_image_tar(
+        tar_bad, _N_STREAM_IMAGES, rng, corrupt=corrupt,
+        corrupt_fn=lambda data: faults.corrupt_jpeg_entropy(data, mode),
+    )
+    survivors = {n for i, n in enumerate(names) if i not in corrupt}
+    tar_ok = os.path.join(tmpdir, f"chaos_native_{seed}_ok.tar")
+    with tarfile.open(tar_bad) as src, tarfile.open(tar_ok, "w") as dst:
+        for m in src:
+            if m.name in survivors:
+                dst.addfile(m, src.extractfile(m))
+
+    def device_cfg():
+        # snapshot pinned OFF (see _jpeg_corrupt_entropy_phase)
+        return ingest.StreamConfig.from_env(
+            decode_mode="device", snapshot_dir=""
+        )
+
+    # KEYSTONE_NATIVE_ENTROPY is managed per leg (not in _clean_env's
+    # fixed key list): "0" pins the Python oracle, unset prefers native.
+    saved_env = os.environ.pop(ne.NATIVE_ENTROPY_ENV, None)
+    try:
+        os.environ[ne.NATIVE_ENTROPY_ENV] = "0"
+        clean_feats, clean_names = _stream_featurize(
+            tar_ok, batch, config=device_cfg()
+        )
+        del os.environ[ne.NATIVE_ENTROPY_ENV]
+
+        # -- leg 1: corrupt scan through the native-preferred stream ----
+        before = counters.get("jpeg_corrupt_entropy")
+        faulted_feats, faulted_names = _stream_featurize(
+            tar_bad, batch, config=device_cfg()
+        )
+        skipped = counters.get("jpeg_corrupt_entropy") - before
+        if skipped != len(corrupt):
+            raise ChaosOracleError(
+                f"{len(corrupt)} entropy-corrupt member(s) but {skipped} "
+                "counted jpeg_corrupt_entropy skips through the native "
+                "backend — a damaged scan was swallowed uncounted (or "
+                "classified differently than the Python pass)"
+            )
+        if faulted_names != clean_names:
+            raise ChaosOracleError(
+                "native-backend stream lost data under entropy "
+                f"corruption: {faulted_names} != {clean_names}"
+            )
+        if not np.array_equal(faulted_feats, clean_feats):
+            raise ChaosOracleError(
+                "native-backend features differ from the forced-Python "
+                "stream on the surviving images — the backends are "
+                "distinguishable"
+            )
+
+        # -- leg 2: forced native failure mid-stream --------------------
+        calls = [0]
+        orig = ne.decode_scan
+
+        def flaky(*args, **kwargs):
+            calls[0] += 1
+            if calls[0] == fail_at:
+                raise RuntimeError("chaos: injected native entropy failure")
+            return orig(*args, **kwargs)
+
+        before_fb = counters.get("native_entropy_fallback")
+        with _patched(ne, "decode_scan", flaky):
+            leg2_feats, leg2_names = _stream_featurize(
+                tar_ok, batch, config=device_cfg()
+            )
+        fell_back = counters.get("native_entropy_fallback") - before_fb
+        if fell_back < 1:
+            raise ChaosOracleError(
+                "injected native entropy failure was not counted "
+                "native_entropy_fallback — it was swallowed silently "
+                f"(decode_scan called {calls[0]} time(s), fail_at "
+                f"{fail_at})"
+            )
+        if leg2_names != clean_names:
+            raise ChaosOracleError(
+                "stream lost data across a per-image native->Python "
+                f"degradation: {leg2_names} != {clean_names}"
+            )
+        if not np.array_equal(leg2_feats, clean_feats):
+            raise ChaosOracleError(
+                "features differ after a per-image native->Python "
+                "degradation — the fallback image was not re-decoded "
+                "cleanly"
+            )
+    finally:
+        if saved_env is None:
+            os.environ.pop(ne.NATIVE_ENTROPY_ENV, None)
+        else:
+            os.environ[ne.NATIVE_ENTROPY_ENV] = saved_env
 
 
 def _profiler_crash_phase(fault: Fault, tmpdir: str, seed: int) -> None:
@@ -2186,6 +2338,10 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "jpeg_corrupt_entropy":
         _jpeg_corrupt_entropy_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "native_entropy":
+        _native_entropy_phase(fault, tmpdir, seed)
         return _run_workload(workload)
 
     if fault.kind == "profiler_crash":

@@ -81,6 +81,14 @@ def pytest_configure(config):
         "auto-picked ports; auto-skipped where spawn or port binding is "
         "unavailable (parallel.distributed.spawn_available)",
     )
+    config.addinivalue_line(
+        "markers",
+        "native_entropy: tests that pin the NATIVE entropy-decode backend "
+        "(ops.native_entropy) — auto-skipped where the toolchain cannot "
+        "build/load the library, so tier-1 stays green on minimal hosts "
+        "(the Python-pass and degradation tests carry no marker and always "
+        "run)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
@@ -88,15 +96,26 @@ def pytest_collection_modifyitems(config, items):
     on hosts without either they skip with the reason named, they do not
     fail."""
     dist_items = [it for it in items if it.get_closest_marker("dist")]
-    if not dist_items:
-        return
-    from keystone_tpu.parallel.distributed import spawn_available
+    if dist_items:
+        from keystone_tpu.parallel.distributed import spawn_available
 
-    if spawn_available():
-        return
-    skip = pytest.mark.skip(
-        reason="multi-process unavailable (no spawn or no bindable port; "
-        "see KEYSTONE_DIST_DISABLE)"
-    )
-    for it in dist_items:
-        it.add_marker(skip)
+        if not spawn_available():
+            skip = pytest.mark.skip(
+                reason="multi-process unavailable (no spawn or no bindable "
+                "port; see KEYSTONE_DIST_DISABLE)"
+            )
+            for it in dist_items:
+                it.add_marker(skip)
+    native_items = [
+        it for it in items if it.get_closest_marker("native_entropy")
+    ]
+    if native_items:
+        from keystone_tpu.ops import native_entropy
+
+        if not native_entropy.available():
+            skip = pytest.mark.skip(
+                reason="native entropy decoder unbuildable/unloadable "
+                "(no g++? see KEYSTONE_NATIVE_ENTROPY)"
+            )
+            for it in native_items:
+                it.add_marker(skip)

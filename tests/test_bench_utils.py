@@ -256,8 +256,13 @@ def test_decode_path_breakdown_records_all_three_paths():
     out = bench._decode_path_breakdown(
         np.random.default_rng(0), batch=6, n_images=12, size=64
     )
-    assert set(out) == {"host_pool", "device", "device_snapshot_warm"}
-    for path, rec in out.items():
+    # ISSUE 19 added a fourth leg: the raw entropy-decode A/B (python vs
+    # native scan loop) over the same corpus.
+    assert set(out) == {
+        "host_pool", "device", "device_snapshot_warm", "entropy_native"
+    }
+    for path in ("host_pool", "device", "device_snapshot_warm"):
+        rec = out[path]
         assert rec["images_per_sec"] > 0, path
         assert rec["overlap_efficiency"] > 0, path
     dev = out["device"]
@@ -266,4 +271,13 @@ def test_decode_path_breakdown_records_all_three_paths():
     warm = out["device_snapshot_warm"]
     assert warm["zero_host_decode"]
     assert warm["dma_bytes"] > 0
+    ent = out["entropy_native"]
+    assert ent["images"] == 12
+    assert ent["python_images_per_sec"] > 0
+    assert ent["backend_live"] in ("native", "python")
+    if "native_images_per_sec" in ent:
+        # ISSUE 19 acceptance bar: native entropy decode >= 3x the Python
+        # bit-reader over the bench corpus (observed ~30x).
+        assert ent["native_images_per_sec"] > 0
+        assert ent["speedup"] >= 3.0, ent
     json.dumps(out)

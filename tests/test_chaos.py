@@ -41,6 +41,7 @@ EXPECTED_COUNTER = {
     "mesh_shrink": "mesh_reanchor",
     "host_loss": "host_reanchor",
     "drift_refit": "lifecycle_refit",
+    "native_entropy": "jpeg_corrupt_entropy",
 }
 
 
@@ -58,13 +59,13 @@ def _check(r):
 def test_chaos_schedule_mnist(seed, tmp_path):
     """Every tier-1 schedule runs TRACED and its trace is held to the
     never-silent bar (the ``chaos_run.py --trace`` invariant, extended
-    from the original 10 families to all 25): every counted fault appears
+    from the original 10 families to all 26): every counted fault appears
     as a kind-tagged ``fault`` instant, every typed error as a failed
     span or fault event."""
     trace_path = str(tmp_path / f"chaos_seed{seed}.json")
     r = chaos.run_schedule(
         seed, "mnist", tmpdir=str(tmp_path), trace_path=trace_path
-    )  # 25 families as of ISSUE 18 (drift_refit)
+    )  # 26 families as of ISSUE 19 (native_entropy)
     _check(r)
     violations = chaos.verify_trace(trace_path, r)
     assert violations == [], {
@@ -144,6 +145,12 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # degrade typed+counted to the incumbent — never a silent wrong or
     # missing answer
     assert "drift_refit" in kinds
+    # Native-entropy coverage (ISSUE 19): the C scan loop must be
+    # indistinguishable from the Python pass — corrupt scans through the
+    # native backend are the same typed counted skips with survivors
+    # bit-equal to a forced-Python stream, and an unexpected native
+    # failure degrades per-image counted, never a crash
+    assert "native_entropy" in kinds
 
 
 def test_schedules_are_deterministic():

@@ -82,6 +82,24 @@ DEFAULT_METRICS: tuple = (
         "extra_metrics.jpeg_decode.by_path.device.golden_max_abs_vs_host",
         "lower", 0.50,
     ),
+    # ISSUE 19: the entropy hot-loop backends (native C vs pure Python).
+    # The native rate regressing means the C loop got slower; the Python
+    # rate is the portable-fallback floor; the speedup regressing toward
+    # 1.0 means the native build stopped paying for itself.
+    (
+        "extra_metrics.jpeg_decode.by_path.entropy_native."
+        "native_images_per_sec",
+        "higher", 0.30,
+    ),
+    (
+        "extra_metrics.jpeg_decode.by_path.entropy_native."
+        "python_images_per_sec",
+        "higher", 0.30,
+    ),
+    (
+        "extra_metrics.jpeg_decode.by_path.entropy_native.speedup",
+        "higher", 0.30,
+    ),
     ("extra_metrics.e2e.cifar.e2e_images_per_sec", "higher", 0.25),
     ("extra_metrics.e2e.cifar.overlap_efficiency", "higher", 0.15),
     ("extra_metrics.e2e.imagenet_fv.e2e_images_per_sec", "higher", 0.25),

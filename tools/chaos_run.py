@@ -22,9 +22,9 @@ appear in its schedule's trace as a counted ``fault`` instant event with a
 matching ``kind`` attribute, and a typed-error outcome must be visible as a
 failed span carrying the error type — typed-error spans are never silent.
 A schedule whose trace misses either fails the run like any other
-violation.  The assertion covers ALL 24 fault families (the streaming,
+violation.  The assertion covers ALL 26 fault families (the streaming,
 snapshot, decode-worker, serving, wire-protocol, placement, elastic-mesh,
-and multi-host families included) and the tier-1 suite runs every schedule
+multi-host, and native-entropy families included) and the tier-1 suite runs every schedule
 traced
 (tests/test_chaos.py), so the invariant is continuously enforced, not just
 on demand.
@@ -60,8 +60,8 @@ def main(argv=None) -> int:
         action="store_true",
         help="run only the streaming-ingest fault schedules "
         "(stream_corrupt / stream_hang / autotune_thrash / "
-        "snapshot_corrupt / decode_worker_kill families, core.ingest + "
-        "core.snapshot paths)",
+        "snapshot_corrupt / decode_worker_kill / jpeg_corrupt_entropy / "
+        "native_entropy families, core.ingest + core.snapshot paths)",
     )
     p.add_argument(
         "--serve",
@@ -122,6 +122,7 @@ def main(argv=None) -> int:
                 in (
                     "autotune_thrash", "snapshot_corrupt",
                     "decode_worker_kill", "jpeg_corrupt_entropy",
+                    "native_entropy",
                 )
             ):
                 return True
