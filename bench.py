@@ -2442,6 +2442,169 @@ def bench_lifecycle(rng):
     }
 
 
+def bench_fleet_observability(rng):
+    """Fleet observability plane (core.fleetobs, ISSUE 20): the
+    cross-host metrics fabric measured four ways — (1) the pure
+    window-merge wall over a synthetic 16-member fleet, (2) a live
+    2-agent scrape wall over real sockets, (3) the collector's serving
+    cost with the SAME off/on harness as the telemetry/profiler/numerics
+    tiers (one warm wire endpoint, same request set, collector detached
+    then attached at a hot interval; <= 5% p99, answers bit-equal), and
+    (4) the 2-subprocess obs-capture drill (SIGKILL one member
+    mid-scrape) whose incident-capture wall and acceptance verdicts ride
+    along.  ``tools/bench_diff.py`` regresses on the walls and the
+    overhead frac (lower is better) and pins
+    ``fleet_observability.drill.dropped_requests`` at zero."""
+    import shutil
+    import tempfile
+
+    from keystone_tpu.core import fleetobs
+    from keystone_tpu.parallel.distributed import spawn_available
+    from keystone_tpu.workloads import multihost as mh
+
+    out: dict = {}
+
+    # -- merge wall: pure window math, 16 members x 4 hists x 512 samples.
+    member_wins = []
+    for _m in range(16):
+        win = {}
+        for h in range(4):
+            samples = np.abs(
+                rng.normal(loc=5.0 + h, scale=1.0, size=512)
+            ).astype(float).tolist()
+            win[f"lat{h}_ms"] = {
+                "count": len(samples), "total": float(sum(samples)),
+                "min": float(min(samples)), "max": float(max(samples)),
+                "samples": samples,
+            }
+        member_wins.append(win)
+    t0 = time.perf_counter()
+    merged = {
+        name: fleetobs.merge_windows([m[name] for m in member_wins])
+        for name in member_wins[0]
+    }
+    summaries = {k: fleetobs.window_summary(v) for k, v in merged.items()}
+    out["merge_wall_s"] = round(time.perf_counter() - t0, 4)
+    out["merge_members"] = len(member_wins)
+    out["merge_samples"] = int(sum(s["count"] for s in summaries.values()))
+
+    # -- scrape wall: two live in-process agents, one timed scrape (the
+    # warm pass absorbs connect + clock sync, as in steady state).
+    with fleetobs.ObsAgent(label="bench-a") as a_agent, \
+            fleetobs.ObsAgent(label="bench-b") as b_agent:
+        col = fleetobs.FleetCollector(
+            [f"{a_agent.host}:{a_agent.port}",
+             f"{b_agent.host}:{b_agent.port}"],
+            interval_s=3600.0, label="bench_fleetobs",
+        )
+        with col:
+            col.scrape_once()
+            t0 = time.perf_counter()
+            snap = col.scrape_once()
+            out["scrape_wall_s"] = round(time.perf_counter() - t0, 4)
+            out["scrape_members"] = snap.get("alive")
+
+    # -- collector on/off serve p99: the same off/on discipline as the
+    # telemetry/profiler/numerics tiers — ONE warm compute-bound engine
+    # (real members spend their wall in GIL-releasing XLA work, so a
+    # trivial engine would measure pure scheduler-convoy noise), the
+    # SAME request set, the on arm scraped by an attached collector.
+    # best-of-3 p99 per arm keeps a shared box's scheduler jitter out of
+    # the ratio.
+    from keystone_tpu.core import serve as kserve
+    from keystone_tpu.core.pipeline import FunctionTransformer
+
+    d = 1024
+    w1 = jnp.asarray(rng.standard_normal((d, d)).astype(np.float32))
+    probe_pipe = FunctionTransformer(
+        lambda v: jnp.tanh(jnp.tanh(v @ w1) @ w1.T) @ w1, name="obsprobe"
+    )
+    probe_engine = kserve.ServingEngine(
+        probe_pipe,
+        np.zeros((d,), np.float32),
+        config=kserve.ServeConfig.from_env(buckets=(1, 4, 16),
+                                           max_wait_ms=2.0),
+        label="bench_fleetobs_probe",
+    )
+    probe_reqs = rng.normal(size=(256, d)).astype(np.float32)
+
+    def serve_pass():
+        return kserve.serve_bench(
+            probe_engine, probe_reqs, clients=4, depth=16,
+            unbatched_baseline=False,
+        )
+
+    serve_pass()  # warm: compile every bucket
+    p99_off = min(serve_pass()["p99_latency_ms"] for _ in range(3))
+    agent = fleetobs.ObsAgent(label="bench_fleetobs_member")
+    pcol = fleetobs.FleetCollector(
+        [f"{agent.host}:{agent.port}"], interval_s=0.2,
+        label="bench_fleetobs_on",
+    )
+    try:
+        pcol.start()
+        on_runs = [serve_pass() for _ in range(3)]
+        pcol.stop()
+        scrapes = pcol.scrapes
+    finally:
+        pcol.close()
+        agent.close()
+    p99_on = min(r["p99_latency_ms"] for r in on_runs)
+    out["collector_overhead"] = {
+        "requests": int(probe_reqs.shape[0]),
+        "p99_off_ms": round(p99_off, 4),
+        "p99_on_ms": round(p99_on, 4),
+        "collector_overhead_frac": round(
+            p99_on / max(p99_off, 1e-9) - 1.0, 4
+        ),
+        "target_frac": 0.05,
+        "scrapes_during_on_pass": scrapes,
+        # The scraped arm's answers stay bit-equal to the offline
+        # oracle — the collector must never perturb served bytes.
+        "bit_identical_on": bool(
+            all(r["predictions_bit_identical"] for r in on_runs)
+        ),
+    }
+
+    # -- the obs-capture drill: 2 REAL worker processes, SIGKILL one
+    # mid-scrape; one clock-aligned incident bundle or the drill says why.
+    if not spawn_available():
+        out["drill"] = {"available": False, "dropped_requests": 0}
+        out["incident_capture_wall_s"] = 0.0
+        return out
+    tmp = tempfile.mkdtemp(prefix="bench_fleetobs_")
+    try:
+        drill = mh.run_obs_capture_drill(
+            tmp, hosts=2, requests=16, seed=0, subprocess_mode=True
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    inc = drill.get("incident") or {}
+    out["drill"] = {
+        "available": True,
+        "mode": drill.get("mode"),
+        "wall_s": round(float(drill.get("wall_s") or 0.0), 3),
+        "scrape_wall_s": drill.get("scrape_wall_s"),
+        "counter_sum_ok": drill.get("counter_sum_ok"),
+        "p99_match": drill.get("p99_match"),
+        "monotone_ok": drill.get("monotone_ok"),
+        "obs_member_lost": drill.get("obs_member_lost"),
+        "dropped_requests": int(drill.get("dropped_requests") or 0),
+        "mismatches": int(drill.get("mismatches") or 0),
+        "incident": {
+            k: inc.get(k)
+            for k in (
+                "trigger", "capture_wall_s", "members", "missing",
+                "n_events", "survivor_rings_ok", "events_monotone",
+                "error",
+            )
+            if k in inc
+        },
+    }
+    out["incident_capture_wall_s"] = float(inc.get("capture_wall_s") or 0.0)
+    return out
+
+
 def bench_numerics(rng, serving: dict | None = None):
     """Numerics observatory (ISSUE 15): a laddered BCD fit runs MONITORED
     — the per-block κ table lands in ``FitReport.conditioning`` (the
@@ -2557,6 +2720,7 @@ def main():
     numerics_sec = _guarded(lambda r: bench_numerics(r, serving), rng)
     multihost_sec = _guarded(bench_multihost, rng)
     lifecycle_sec = _guarded(bench_lifecycle, rng)
+    fleetobs_sec = _guarded(bench_fleet_observability, rng)
     at_scale = _guarded(bench_solve_at_scale, rng)
 
     # ONE atomic registry snapshot feeds both the back-compat "faults" key
@@ -2672,6 +2836,12 @@ def main():
             # dropped_requests pinned at 0 — the zero-downtime hot-swap
             # claim, re-proven every round.
             "lifecycle": lifecycle_sec,
+            # Fleet observability plane (core.fleetobs, ISSUE 20): the
+            # window-merge and live-scrape walls, the collector's
+            # off/on serving p99 (<= 5% bar, answers bit-equal), and the
+            # 2-subprocess obs-capture drill's incident-capture wall
+            # with dropped_requests pinned at 0.
+            "fleet_observability": fleetobs_sec,
         },
     }
     # Regression observatory (ISSUE 11): this round judged against the
@@ -2885,6 +3055,34 @@ def main():
             f"{lcx['dropped_requests']} dropped, bit-equal "
             f"{lcx['post_swap_bit_equal']}"
         )
+    fox = ex["fleet_observability"]
+    if "error" in fox:
+        print(f"# fleet_observability: {fox['error'][:120]}")
+    else:
+        co = fox["collector_overhead"]
+        print(
+            f"# fleet_observability: scrape {fox['scrape_wall_s']}s "
+            f"({fox['scrape_members']} member(s)), merge "
+            f"{fox['merge_wall_s']}s ({fox['merge_samples']} samples), "
+            f"collector p99 {co['p99_off_ms']}ms off -> "
+            f"{co['p99_on_ms']}ms on "
+            f"({co['collector_overhead_frac']:+.2%}, target <= "
+            f"{co['target_frac']:.0%}, bit_identical "
+            f"{co['bit_identical_on']})"
+        )
+        fdr = fox["drill"]
+        if not fdr.get("available"):
+            print("# fleet_observability drill: spawn unavailable — "
+                  "zero-base rows")
+        else:
+            print(
+                f"# fleet_observability drill ({fdr['mode']}): incident "
+                f"capture {fox['incident_capture_wall_s']}s, counter_sum "
+                f"{fdr['counter_sum_ok']}, p99_match {fdr['p99_match']}, "
+                f"monotone {fdr['monotone_ok']}, "
+                f"{fdr['dropped_requests']} dropped / "
+                f"{fdr['mismatches']} mismatched"
+            )
     bd = record["bench_diff"]
     if "verdict" in bd:
         print(

@@ -1070,6 +1070,7 @@ class HostFleet:
         self._rr = 0
         self._rr_lock = threading.Lock()
         self.lost_hosts = 0
+        self._collector = None
         trace.instant(
             "fleet.up",
             label=label,
@@ -1101,6 +1102,16 @@ class HostFleet:
 
     def alive_hosts(self) -> list:
         return [h["endpoint"] for h in self._hosts if h["alive"]]
+
+    def attach_collector(self, collector) -> None:
+        """Self-register the whole fleet with a
+        :class:`~.fleetobs.FleetCollector`: every current member becomes
+        an observed obs agent (the serving socket doubles as the obs
+        endpoint), and members re-admitted later via :meth:`reattach`
+        register too."""
+        self._collector = collector
+        for rank, h in enumerate(self._hosts):
+            collector.register(h["endpoint"], rank=rank)
 
     def predict(self, arr, timeout: float | None = None):
         """Answer one request through some live host.  A member that dies
@@ -1151,6 +1162,8 @@ class HostFleet:
                 h["alive"] = True
                 h["client"] = None
                 trace.instant("fleet.reattach", endpoint=list(endpoint))
+                if self._collector is not None:
+                    self._collector.register(endpoint)
                 return
         self._hosts.append(
             {
@@ -1163,6 +1176,8 @@ class HostFleet:
             }
         )
         trace.instant("fleet.reattach", endpoint=list(endpoint))
+        if self._collector is not None:
+            self._collector.register(endpoint, rank=len(self._hosts) - 1)
 
     def record(self) -> dict:
         return {

@@ -114,6 +114,12 @@ POSTMORTEM_KINDS = frozenset(
         "lifecycle_refit",
         "refit_rejected",
         "refit_failed",
+        # Fleet observability (ISSUE 20): the collector declaring a fleet
+        # member unreachable mid-scrape is itself a topology-evidence
+        # event — the postmortem (and the cross-host incident bundle the
+        # collector writes alongside it) captures the last merged fleet
+        # view and every surviving member's flight ring.
+        "obs_member_lost",
     }
 )
 
@@ -440,6 +446,29 @@ def _fmt(value) -> str:
     return repr(float(value))
 
 
+_LABEL_VALUE_RE = re.compile(r'["\\\n]')
+
+
+def render_labels(labels: dict | None, extra: str = "") -> str:
+    """Prometheus label block: ``{host="h0",rank="0"}`` — keys sorted and
+    sanitized like metric names, values escaped per the exposition format.
+    ``extra`` is a pre-rendered ``key="value"`` pair appended last (the
+    histogram quantile label).  Empty labels and empty extra render ``""``."""
+    pairs = []
+    for k in sorted(labels or {}):
+        v = labels[k]
+        if v is None:
+            continue
+        val = _LABEL_VALUE_RE.sub(
+            lambda m: {"\\": "\\\\", '"': '\\"', "\n": "\\n"}[m.group()],
+            str(v),
+        )
+        pairs.append(f'{_NAME_RE.sub("_", str(k))}="{val}"')
+    if extra:
+        pairs.append(extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
 def _flatten(prefix: tuple, obj, out: list) -> None:
     """Numeric leaves of an adopted group's nested snapshot, depth-first,
     as (name_parts, value) — non-numeric leaves are skipped (labels and
@@ -451,35 +480,44 @@ def _flatten(prefix: tuple, obj, out: list) -> None:
         out.append((prefix, obj))
 
 
-def prometheus_text(snapshot: dict | None = None) -> str:
+def prometheus_text(
+    snapshot: dict | None = None, labels: dict | None = None
+) -> str:
     """Render a ``trace.metrics`` snapshot (default: a fresh one) in the
     Prometheus text exposition format, deterministically ordered.
     Counters/gauges map 1:1; histograms render as summaries (quantile
     labels + ``_sum``/``_count``); adopted groups flatten to gauges
-    (``faults`` to counters) prefixed with the group name."""
+    (``faults`` to counters) prefixed with the group name.
+
+    ``labels`` (e.g. ``{"host": "h0", "rank": 0}``) attaches the same
+    label set to EVERY sample line — the multi-process scrape story
+    (core.fleetobs labels each member's exposition ``host=``/``rank=``
+    so one fleet page carries N processes without name collisions).
+    ``labels=None`` renders byte-identically to the pre-label format
+    (golden-pinned)."""
     snap = snapshot if snapshot is not None else trace.metrics.snapshot()
+    lbl = render_labels(labels)
     lines: list[str] = []
     for name in sorted(snap.get("counters", {})):
         m = _metric_name(name)
         lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {_fmt(snap['counters'][name])}")
+        lines.append(f"{m}{lbl} {_fmt(snap['counters'][name])}")
     for name in sorted(snap.get("gauges", {})):
         m = _metric_name(name)
         lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {_fmt(snap['gauges'][name])}")
+        lines.append(f"{m}{lbl} {_fmt(snap['gauges'][name])}")
     for name in sorted(snap.get("histograms", {})):
         h = snap["histograms"][name]
         m = _metric_name(name)
         lines.append(f"# TYPE {m} summary")
         for q in ("p50", "p90", "p99"):
             if q in h:
-                lines.append(
-                    f'{m}{{quantile="0.{q[1:]}"}} {_fmt(h[q])}'
-                )
+                qlbl = render_labels(labels, extra=f'quantile="0.{q[1:]}"')
+                lines.append(f"{m}{qlbl} {_fmt(h[q])}")
         count = h.get("count", 0)
         mean = h.get("mean", 0.0)
-        lines.append(f"{m}_sum {_fmt(mean * count)}")
-        lines.append(f"{m}_count {_fmt(count)}")
+        lines.append(f"{m}_sum{lbl} {_fmt(mean * count)}")
+        lines.append(f"{m}_count{lbl} {_fmt(count)}")
     for group in sorted(snap):
         if group in ("counters", "gauges", "histograms"):
             continue
@@ -489,7 +527,7 @@ def prometheus_text(snapshot: dict | None = None) -> str:
         for parts, value in flat:
             m = _metric_name(*parts)
             lines.append(f"# TYPE {m} {kind}")
-            lines.append(f"{m} {_fmt(value)}")
+            lines.append(f"{m}{lbl} {_fmt(value)}")
     return "\n".join(lines) + "\n"
 
 

@@ -673,6 +673,24 @@ class Metrics:
                 h = self._hists[name] = _Hist()
             h.observe(value)
 
+    def hist_windows(self) -> dict:
+        """Raw per-histogram sample windows (count/total/min/max plus the
+        bounded sample deque as a list) — the wire payload the fleet
+        observability plane ships so FLEET percentiles come from pooled
+        samples, not averaged per-host percentiles (core.fleetobs)."""
+        with self._lock:
+            return {
+                k: {
+                    "count": h.count,
+                    "total": h.total,
+                    "min": h.min,
+                    "max": h.max,
+                    "samples": list(h.samples),
+                }
+                for k, h in self._hists.items()
+                if h.count
+            }
+
     # groups -----------------------------------------------------------------
     def adopt(self, name: str, group) -> None:
         """Register an external counter group (must expose

@@ -96,6 +96,17 @@ T_CLOCK = 7
 #: client-side span the request rode in, so ``trace_view --stitch`` can
 #: join the two processes' timelines by more than the rid alone.
 T_REQUEST_TRACED = 8
+#: Fleet observability (ISSUE 20, core.fleetobs): the collector asks a
+#: member for its process-local observability surface.  The reply echoes
+#: the frame type with a JSON body (utf-8) — the member's registry
+#: snapshot + statusz + raw histogram sample windows (T_OBS_SNAPSHOT) or
+#: its flight-recorder ring (T_OBS_FLIGHT), each stamped with the
+#: member's ``trace.now_us`` so the collector can clock-align it via the
+#: T_CLOCK offset handshake.  Old servers answer the unknown type with an
+#: ERROR frame — a collector scraping a pre-obs member degrades, it does
+#: not die.
+T_OBS_SNAPSHOT = 9
+T_OBS_FLIGHT = 10
 
 _LEN = struct.Struct("!I")
 _HEAD = struct.Struct("!BBQ")  # version, type, request_id
@@ -279,6 +290,30 @@ def split_trace_context(body) -> tuple[int, memoryview]:
     except struct.error as e:
         raise WireProtocolError(f"truncated trace context: {e}") from None
     return int(span), body[_SPAN.size:]
+
+
+def encode_obs(ftype: int, rid: int, payload: dict) -> bytes:
+    """Observability frame: ``payload`` as a JSON utf-8 body (the obs
+    surface is nested/stringly — numpy framing buys nothing here)."""
+    import json
+
+    return encode_frame(
+        ftype, rid, json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    )
+
+
+def decode_obs(body) -> dict:
+    import json
+
+    try:
+        doc = json.loads(bytes(memoryview(body)).decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise WireProtocolError(f"bad obs body: {e}") from None
+    if not isinstance(doc, dict):
+        raise WireProtocolError(
+            f"obs body is {type(doc).__name__}, expected an object"
+        )
+    return doc
 
 
 def decode_retry_after(body) -> tuple[float, str]:
@@ -523,6 +558,24 @@ class WireServer:
             self._send(
                 conn, encode_clock_reply(rid, t_client, trace.now_us())
             )
+            return
+        if ftype in (T_OBS_SNAPSHOT, T_OBS_FLIGHT):
+            # Fleet observability scrape (core.fleetobs): EVERY wire
+            # server doubles as its process's obs agent — the collector
+            # reuses the serving port it already knows.  A failing
+            # payload build answers a typed ERROR frame; the serving
+            # path is never touched.
+            try:
+                from . import fleetobs
+
+                payload = fleetobs.agent_payload(
+                    "flight" if ftype == T_OBS_FLIGHT else "snapshot"
+                )
+                self._send(conn, encode_obs(ftype, rid, payload))
+            except Exception as e:  # noqa: BLE001 — typed delivery
+                self._send(
+                    conn, encode_error(rid, type(e).__name__, str(e))
+                )
             return
         if ftype not in (T_REQUEST, T_REQUEST_TRACED):
             with self._lock:
@@ -788,6 +841,8 @@ class WireReply:
     retry_after_s: float | None = None
     #: T_CLOCK reply: (client trace clock echoed, server trace clock) us
     clock: tuple | None = None
+    #: T_OBS_* reply: the member's JSON observability payload
+    obs: dict | None = None
 
 
 class WireClient:
@@ -863,6 +918,32 @@ class WireClient:
                 }
         return best
 
+    def _obs(self, ftype: int) -> dict | None:
+        """One observability round trip; None when the server predates
+        the obs frames (it answers ERROR — the collector degrades)."""
+        self._next_id += 1
+        rid = self._next_id
+        self._sock.sendall(encode_frame(ftype, rid))
+        reply = self.read()
+        if reply.type == T_ERROR:
+            return None
+        if reply.type != ftype or reply.request_id != rid:
+            raise WireProtocolError(
+                f"expected OBS {ftype} id {rid}, got type {reply.type} "
+                f"id {reply.request_id}"
+            )
+        return reply.obs
+
+    def obs_snapshot(self) -> dict | None:
+        """The member's observability snapshot: statusz + registry
+        snapshot + raw histogram sample windows, stamped with its
+        ``trace.now_us`` (core.fleetobs scrapes through this)."""
+        return self._obs(T_OBS_SNAPSHOT)
+
+    def obs_flight(self) -> dict | None:
+        """The member's flight-recorder ring (incident capture)."""
+        return self._obs(T_OBS_FLIGHT)
+
     def ping(self) -> float:
         """Round-trip one PING; returns seconds."""
         t0 = time.perf_counter()
@@ -903,6 +984,8 @@ class WireClient:
             return WireReply(ftype, rid, retry_after_s=seconds, message=msg)
         if ftype == T_CLOCK:
             return WireReply(ftype, rid, clock=decode_clock_reply(body))
+        if ftype in (T_OBS_SNAPSHOT, T_OBS_FLIGHT):
+            return WireReply(ftype, rid, obs=decode_obs(body))
         return WireReply(ftype, rid)
 
     def predict(self, arr, timeout: float = 30.0) -> np.ndarray:

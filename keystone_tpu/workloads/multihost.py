@@ -1004,6 +1004,396 @@ def _run_drill_inprocess(
     _judge_answers(record, results, errors, expected)
 
 
+# -- obs-capture drill --------------------------------------------------------
+
+
+def _pooled_p99_oracle(record, direct, fleet_hists) -> None:
+    """Acceptance (b): the collector's fleet p99 must equal the pick-rule
+    percentile of the POOLED per-member samples, and sit near the numpy
+    linear-interpolation percentile of the same pool."""
+    metric = None
+    for cand in ("serve_latency_ms", "wire_request_ms"):
+        if any(
+            cand in (d.get("hist_windows") or {}) for d in direct.values()
+        ):
+            metric = cand
+            break
+    record["pooled_metric"] = metric
+    if metric is None:
+        record["p99_match"] = False
+        return
+    pool = [
+        float(s)
+        for d in direct.values()
+        for s in d["hist_windows"].get(metric, {}).get("samples", ())
+    ]
+    pool.sort()
+    record["p99_pool_n"] = len(pool)
+    fleet_p99 = fleet_hists.get(metric, {}).get("p99")
+    pick = pool[min(len(pool) - 1, int(0.99 * len(pool)))] if pool else None
+    p99_np = float(np.percentile(pool, 99)) if pool else None
+    record["p99_fleet"] = fleet_p99
+    record["p99_oracle_pick"] = pick
+    record["p99_oracle_np"] = p99_np
+    record["p99_match"] = (
+        fleet_p99 is not None
+        and fleet_p99 == pick
+        and abs(fleet_p99 - p99_np) <= max(0.25 * abs(p99_np), 1e-6)
+    )
+
+
+def _counter_sum_check(record, snap, direct) -> None:
+    """Acceptance (a): fleet counters == the sum of per-member snapshots,
+    key for key, both directions."""
+    sums: dict = {}
+    for d in direct.values():
+        stz = d.get("statusz", {})
+        for group in ("counters", "faults"):
+            for k, v in (stz.get(group) or {}).items():
+                sums[k] = sums.get(k, 0) + v
+    fleet = dict(snap.get("counters", {}))
+    for k, v in snap.get("faults", {}).items():
+        fleet[k] = fleet.get(k, 0) + v
+    mismatched = {
+        k: (fleet.get(k), sums.get(k))
+        for k in set(fleet) | set(sums)
+        if fleet.get(k, 0) != sums.get(k, 0)
+    }
+    record["counter_sum_ok"] = not mismatched
+    if mismatched:
+        record["counter_sum_mismatch"] = {
+            k: list(v) for k, v in sorted(mismatched.items())[:8]
+        }
+
+
+def _judge_incident(record, bundle_path, survivor_keys) -> None:
+    """Acceptance (c): ONE bundle, every surviving member's ring present
+    and non-empty, events on one monotone clock-aligned timeline."""
+    with open(bundle_path) as fh:
+        doc = json.load(fh)
+    members = doc.get("members", {})
+    ts = [
+        ev["ts"]
+        for ev in doc.get("events", [])
+        if isinstance(ev.get("ts"), (int, float))
+    ]
+    record["incident"] = {
+        "path": bundle_path,
+        "schema": doc.get("schema"),
+        "trigger": doc.get("trigger", {}).get("kind"),
+        "capture_wall_s": doc.get("capture_wall_s"),
+        "members": sorted(members),
+        "missing": doc.get("missing", []),
+        "n_events": len(doc.get("events", [])),
+        "survivor_rings_ok": all(
+            k in members and members[k].get("events", 0) > 0
+            for k in survivor_keys
+        ),
+        "events_monotone": ts == sorted(ts),
+    }
+
+
+def run_obs_capture_drill(
+    tmpdir: str,
+    *,
+    hosts: int = 2,
+    requests: int = 18,
+    seed: int = 0,
+    local_devices: int = 2,
+    subprocess_mode: bool | None = None,
+    timeout_s: float = 240.0,
+) -> dict:
+    """The fleet-observability acceptance drill (ISSUE 20): serve across
+    N members with a :class:`~..core.fleetobs.FleetCollector` attached,
+    prove on a QUIET fleet that (a) fleet counters equal the sum of
+    per-member snapshots and (b) fleet p99 comes from the pooled sample
+    windows, then SIGKILL one member mid-scrape and prove (c) the
+    collector degrades (``obs_member_lost``), stays monotone for the
+    survivors, and writes ONE clock-aligned incident bundle holding
+    every surviving member's flight ring — while every request still
+    answers bit-equal to the offline oracle (collection must not touch
+    the serving answers).
+
+    ``subprocess_mode=False`` degrades to in-process wire servers (one
+    process, N sockets) with an abrupt socket close standing in for the
+    SIGKILL — the same collector/merge/incident code paths on hosts
+    without spawn."""
+    from keystone_tpu.parallel import distributed as kdist
+
+    if subprocess_mode is None:
+        subprocess_mode = kdist.spawn_available()
+    if hosts < 2:
+        raise ValueError("the drill needs >= 2 hosts (one must die)")
+
+    import jax.numpy as jnp
+
+    from keystone_tpu.core import frontend as kfrontend
+    from keystone_tpu.core import trace
+    from keystone_tpu.core.resilience import counters
+
+    mean, std, model = _drill_model(seed)
+    stem = _drill_ckpt(tmpdir, seed, mean, std)
+    rows = np.asarray(
+        np.random.default_rng((seed, 17)).normal(size=(requests, FEAT_DIM)),
+        np.float32,
+    )
+    expected = np.asarray(model(jnp.asarray(rows)))
+
+    pm_dir = os.path.join(tmpdir, "postmortems")
+    os.makedirs(pm_dir, exist_ok=True)
+    incident_dir = os.path.join(tmpdir, "incidents")
+    old_pm = os.environ.get("KEYSTONE_POSTMORTEM_DIR")
+    os.environ["KEYSTONE_POSTMORTEM_DIR"] = pm_dir
+    kill_rank = hosts - 1
+    survivors = [r for r in range(hosts) if r != kill_rank]
+    t_start = time.monotonic()
+    record: dict = {
+        "mode": "subprocess" if subprocess_mode else "inprocess",
+        "hosts": hosts,
+        "kill_rank": kill_rank,
+        "requests": requests,
+        "incident_dir": incident_dir,
+    }
+    try:
+        if subprocess_mode:
+            _run_obs_drill_subprocess(
+                record, tmpdir, stem, seed, hosts, kill_rank, survivors,
+                rows, expected, local_devices, timeout_s, kdist, kfrontend,
+                counters,
+            )
+        else:
+            _run_obs_drill_inprocess(
+                record, stem, seed, hosts, kill_rank, survivors, rows,
+                expected, timeout_s, kdist, kfrontend, counters,
+            )
+    finally:
+        if old_pm is None:
+            os.environ.pop("KEYSTONE_POSTMORTEM_DIR", None)
+        else:
+            os.environ["KEYSTONE_POSTMORTEM_DIR"] = old_pm
+    record["postmortems"] = sorted(os.listdir(pm_dir))
+    record["wall_s"] = round(time.monotonic() - t_start, 3)
+    trace.instant(
+        "obs_capture_drill", mode=record["mode"], hosts=hosts,
+        dropped=record["dropped_requests"],
+        mismatches=record["mismatches"],
+        incidents=len(record.get("incidents", [])),
+    )
+    return record
+
+
+def _obs_drill_collector_phase(
+    record, col, fleet, endpoints, kill, rows, expected, survivor_keys,
+    timeout_s, counters, kwire,
+):
+    """The collector-side drill body shared by both modes: quiet-fleet
+    merge checks, mid-scrape member death, incident + monotonicity
+    judgement.  ``kill()`` is the mode's way of killing the chosen
+    member."""
+    from keystone_tpu.core import fleetobs  # noqa: F401 — drill subject
+
+    n = len(rows)
+    results: list = [None] * n
+    errors: list = []
+    fleet.attach_collector(col)
+    col.start()
+    # Wave 1: drive then DRAIN, so the merge checks compare a quiet fleet
+    # (counters moving under the comparison would fake a mismatch).
+    join = _drive_fleet(fleet, rows, results, errors, indices=range(n // 2))
+    if not join(timeout_s / 4):
+        raise TimeoutError("obs drill wave 1 did not drain")
+    col.stop()
+    # Quiet-fleet comparison discipline: one warm scrape FIRST (any
+    # pending collector connect/clock handshake lands now), then the
+    # direct pulls (each opens a fresh connection the member counts in
+    # the very payload it returns), then the comparison scrape — which
+    # reuses live connections and moves nothing, so both sides total the
+    # same ``wire_connections``.
+    col.scrape_once()
+    direct = {}
+    clients = [kwire.WireClient(ep[0], ep[1], timeout=10.0) for ep in endpoints]
+    try:
+        # All connections open BEFORE any payload is read: in-process
+        # members share one registry, so a later connect would move the
+        # counters an earlier payload already reported.
+        for ep, c in zip(endpoints, clients):
+            d = c.obs_snapshot()
+            if d is not None:
+                direct[f"{ep[0]}:{ep[1]}"] = d
+    finally:
+        for c in clients:
+            c.close()
+    t0 = time.monotonic()
+    snap_before = col.scrape_once()
+    record["scrape_wall_s"] = round(time.monotonic() - t0, 4)
+    _counter_sum_check(record, snap_before, direct)
+    _pooled_p99_oracle(record, direct, snap_before.get("histograms", {}))
+    lost_before = counters.counts().get("obs_member_lost", 0)
+    col.start()  # scraping again: the death below lands mid-cadence
+    # Wave 2: the kill lands while requests AND scrapes are in flight.
+    join = _drive_fleet(
+        fleet, rows, results, errors, indices=range(n // 2, n)
+    )
+    kill()
+    record["killed_at_answered"] = _answered(results)
+    if not join(timeout_s / 2):
+        raise TimeoutError("obs drill wave 2 did not drain")
+    # The collector notices on its own cadence; force one pass if the
+    # window closes first (alive->dead triggers exactly once either way).
+    end = time.monotonic() + timeout_s / 4
+    while (
+        counters.counts().get("obs_member_lost", 0) <= lost_before
+        and time.monotonic() < end
+    ):
+        time.sleep(0.05)
+    col.stop()
+    if counters.counts().get("obs_member_lost", 0) <= lost_before:
+        col.scrape_once()
+    snap_after = col.scrape_once()
+    record["obs_member_lost"] = (
+        counters.counts().get("obs_member_lost", 0) - lost_before
+    )
+    non_mono = {
+        k: (v, snap_after["counters"].get(k, 0))
+        for k, v in snap_before["counters"].items()
+        if snap_after["counters"].get(k, 0) < v
+    }
+    record["monotone_ok"] = not non_mono
+    if non_mono:
+        record["monotone_violations"] = {
+            k: list(v) for k, v in sorted(non_mono.items())[:8]
+        }
+    record["fleet_alive"] = snap_after["alive"]
+    record["fleet_lost"] = snap_after["lost"]
+    record["healthz"] = col.fleet_healthz()
+    record["incidents"] = list(col.incident_paths)
+    record["collector"] = col.record()
+    record["fleet"] = fleet.record()
+    _judge_answers(record, results, errors, expected)
+    bundles = [
+        p for p in col.incident_paths if "obs_member_lost" in p
+    ]
+    if len(bundles) == 1:
+        _judge_incident(record, bundles[0], survivor_keys)
+    else:
+        record["incident"] = {"error": f"{len(bundles)} bundle(s)"}
+
+
+def _run_obs_drill_subprocess(
+    record, tmpdir, stem, seed, hosts, kill_rank, survivors, rows,
+    expected, local_devices, timeout_s, kdist, kfrontend, counters,
+) -> None:
+    from keystone_tpu.core import fleetobs
+    from keystone_tpu.core import wire as kwire
+
+    pm_dir = os.environ["KEYSTONE_POSTMORTEM_DIR"]
+    workers: list[_WorkerIO] = []
+    try:
+        for r in range(hosts):
+            env = _hermetic_env(
+                kdist.worker_env(
+                    r, hosts, "controller", local_devices=local_devices
+                ),
+                tmpdir, f"obshost{r}",
+            )
+            env["KEYSTONE_POSTMORTEM_DIR"] = pm_dir
+            workers.append(
+                _WorkerIO(
+                    _worker_cmd(
+                        "serve-host", ["--ckpt", stem, "--seed", str(seed)]
+                    ),
+                    env,
+                    os.path.join(tmpdir, f"obshost{r}.err"),
+                )
+            )
+        up = [w.expect("port", timeout_s / 2) for w in workers]
+        endpoints = [("127.0.0.1", msg["port"]) for msg in up]
+        survivor_keys = [f"127.0.0.1:{up[r]['port']}" for r in survivors]
+        with fleetobs.FleetCollector(
+            interval_s=0.1, incident_dir=record["incident_dir"],
+            window_s=5.0, label="obs-drill",
+        ) as col, kfrontend.HostFleet(endpoints, label="obs-drill") as fleet:
+            _obs_drill_collector_phase(
+                record, col, fleet, endpoints,
+                workers[kill_rank].kill, rows, expected, survivor_keys,
+                timeout_s, counters, kwire,
+            )
+        finals = {}
+        for r in survivors:
+            workers[r].send("quit")
+            finals[r] = workers[r].expect("final", timeout_s / 4)["final"]
+        record["survivor_counters"] = {
+            r: finals[r]["counters"] for r in survivors
+        }
+    finally:
+        record["worker_rcs"] = [w.finish() for w in workers]
+
+
+def _run_obs_drill_inprocess(
+    record, stem, seed, hosts, kill_rank, survivors, rows, expected,
+    timeout_s, kdist, kfrontend, counters,
+) -> None:
+    import jax
+
+    from keystone_tpu.core import fleetobs
+    from keystone_tpu.core import serve as kserve
+    from keystone_tpu.core import wire as kwire
+    from keystone_tpu.core.checkpoint import load_pipeline
+    from keystone_tpu.parallel import mesh as kmesh
+
+    devs = jax.devices()
+    per = max(1, min(2, len(devs) // hosts))
+    routers, servers = [], []
+    try:
+        meshes = [
+            kmesh.make_mesh(
+                data=per, model=1, devices=devs[r * per : (r + 1) * per]
+            )
+            for r in range(hosts)
+        ]
+        for r in range(hosts):
+            model_r = load_pipeline(stem, mesh=meshes[r])
+
+            def build(shape, dtype, mesh_or_none, _m=model_r, _r=r):
+                return kserve.ServingEngine(
+                    _m,
+                    np.zeros(shape, dtype),
+                    config=kserve.ServeConfig(
+                        buckets=(1, 2, 4), max_wait_ms=2.0
+                    ),
+                    label=f"obshost{_r}:{'x'.join(str(d) for d in shape)}",
+                    mesh=mesh_or_none,
+                )
+
+            factory = kfrontend.MeshEngineFactory(build, mesh=meshes[r])
+            router = kfrontend.ShapeRouter(factory, label=f"obshost{r}")
+            router.add_engine(factory((FEAT_DIM,), np.float32))
+            routers.append(router)
+            servers.append(
+                kwire.WireServer(router, port=0, label=f"obshost{r}")
+            )
+        endpoints = [("127.0.0.1", s.port) for s in servers]
+        survivor_keys = [f"127.0.0.1:{servers[r].port}" for r in survivors]
+        with fleetobs.FleetCollector(
+            interval_s=0.1, incident_dir=record["incident_dir"],
+            window_s=5.0, label="obs-drill",
+        ) as col, kfrontend.HostFleet(endpoints, label="obs-drill") as fleet:
+            _obs_drill_collector_phase(
+                record, col, fleet, endpoints,
+                servers[kill_rank].close, rows, expected, survivor_keys,
+                timeout_s, counters, kwire,
+            )
+        record["survivor_counters"] = {
+            r: counters.snapshot() for r in survivors
+        }
+    finally:
+        for r, s in enumerate(servers):
+            if r != kill_rank:
+                s.close()
+        for router in routers:
+            router.close()
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] not in ("fit-serve", "serve-host"):

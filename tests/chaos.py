@@ -219,6 +219,7 @@ FAMILIES = (
     "host_loss",
     "drift_refit",
     "native_entropy",
+    "obs_capture",
 )
 
 #: The serving-path families (core.serve / core.frontend / core.wire),
@@ -234,8 +235,8 @@ SERVE_FAMILIES = (
 
 #: Seeds the tier-1 suite runs (small schedule, covers every family);
 #: ``-m chaos`` / ``tools/chaos_run.py --full`` runs the full schedule.
-TIER1_SEEDS = tuple(range(26))
-FULL_SEEDS = tuple(range(52))
+TIER1_SEEDS = tuple(range(27))
+FULL_SEEDS = tuple(range(54))
 
 _DATA_SEED = 20260803  # fixed: the fault-free baseline is schedule-invariant
 _N_TAR_IMAGES = 6
@@ -457,6 +458,14 @@ def make_schedule(seed: int) -> Fault:
             {
                 "hosts": 2,  # tools/chaos_run.py --hosts N overrides via env
                 "requests": int(rng.integers(14, 25)),
+            },
+        )
+    if kind == "obs_capture":
+        return Fault(
+            kind,
+            {
+                "hosts": 2,
+                "requests": int(rng.integers(12, 21)),
             },
         )
     if kind == "drift_refit":
@@ -1945,6 +1954,90 @@ def _host_loss_phase(fault: Fault, tmpdir: str, seed: int) -> None:
         )
 
 
+def _obs_capture_phase(fault: Fault, tmpdir: str, seed: int) -> None:
+    """A fleet member is SIGKILLed mid-scrape (ISSUE 20): drive the
+    fleet-observability drill (real subprocess members where spawn is
+    available, in-process wire fleet otherwise) and hold the collector to
+    its bar — fleet counters equal the sum of per-member snapshots, fleet
+    p99 comes from the pooled sample windows, the loss is counted
+    ``obs_member_lost`` (postmortem-linked) with the fleet view monotone
+    for the survivors, ONE clock-aligned incident bundle holds every
+    surviving member's flight ring, and every request still answers
+    bit-equal to the offline oracle — collection never touches serving."""
+    from keystone_tpu.workloads.multihost import run_obs_capture_drill
+
+    hosts = int(
+        os.environ.get("KEYSTONE_CHAOS_HOSTS", fault.params["hosts"])
+    )
+    lost_before = counters.get("obs_member_lost")
+    rec = run_obs_capture_drill(
+        tmpdir,
+        hosts=hosts,
+        requests=int(fault.params["requests"]),
+        seed=seed,
+        timeout_s=180.0,
+    )
+    if rec["dropped_requests"] != 0:
+        raise ChaosOracleError(
+            f"obs drill dropped {rec['dropped_requests']} request(s) "
+            f"({rec['answered']}/{rec['requests']} answered; "
+            f"errors: {rec['errors']})"
+        )
+    if rec["mismatches"] != 0:
+        raise ChaosOracleError(
+            f"{rec['mismatches']} answer(s) differ from the offline "
+            "oracle with the collector attached — collection touched "
+            "the serving answers"
+        )
+    if not rec.get("counter_sum_ok"):
+        raise ChaosOracleError(
+            "fleet counters != sum of per-member snapshots: "
+            f"{rec.get('counter_sum_mismatch')}"
+        )
+    if not rec.get("p99_match"):
+        raise ChaosOracleError(
+            f"fleet p99 {rec.get('p99_fleet')} does not come from the "
+            f"pooled windows (pick oracle {rec.get('p99_oracle_pick')}, "
+            f"numpy oracle {rec.get('p99_oracle_np')}, "
+            f"pool n={rec.get('p99_pool_n')})"
+        )
+    if not rec.get("monotone_ok"):
+        raise ChaosOracleError(
+            "fleet counters stepped BACKWARDS across the member loss: "
+            f"{rec.get('monotone_violations')}"
+        )
+    if counters.get("obs_member_lost") - lost_before < 1:
+        raise ChaosOracleError(
+            "the collector never counted the member loss "
+            "(obs_member_lost)"
+        )
+    incident = rec.get("incident") or {}
+    if incident.get("error"):
+        raise ChaosOracleError(
+            f"incident capture wrote {incident['error']} for one member "
+            "loss — expected exactly one bundle"
+        )
+    if incident.get("schema") != "keystone.incident/1":
+        raise ChaosOracleError(
+            f"incident bundle is not schema-tagged: {incident}"
+        )
+    if not incident.get("survivor_rings_ok"):
+        raise ChaosOracleError(
+            "the incident bundle is missing a surviving member's flight "
+            f"ring: {incident}"
+        )
+    if not incident.get("events_monotone"):
+        raise ChaosOracleError(
+            "incident bundle events are not on one monotone clock-aligned "
+            "timeline"
+        )
+    pm = [p for p in rec["postmortems"] if "obs_member_lost" in p]
+    if not pm:
+        raise ChaosOracleError(
+            f"no obs_member_lost postmortem dumped (got {rec['postmortems']})"
+        )
+
+
 def _stepdown_oracle(
     res: dict,
     stepdown_delta: int,
@@ -2358,6 +2451,10 @@ def _run_faulted(fault: Fault, workload: str, tmpdir: str, seed: int):
 
     if fault.kind == "host_loss":
         _host_loss_phase(fault, tmpdir, seed)
+        return _run_workload(workload)
+
+    if fault.kind == "obs_capture":
+        _obs_capture_phase(fault, tmpdir, seed)
         return _run_workload(workload)
 
     if fault.kind == "drift_refit":

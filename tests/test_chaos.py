@@ -42,6 +42,7 @@ EXPECTED_COUNTER = {
     "host_loss": "host_reanchor",
     "drift_refit": "lifecycle_refit",
     "native_entropy": "jpeg_corrupt_entropy",
+    "obs_capture": "obs_member_lost",
 }
 
 
@@ -59,13 +60,13 @@ def _check(r):
 def test_chaos_schedule_mnist(seed, tmp_path):
     """Every tier-1 schedule runs TRACED and its trace is held to the
     never-silent bar (the ``chaos_run.py --trace`` invariant, extended
-    from the original 10 families to all 26): every counted fault appears
+    from the original 10 families to all 27): every counted fault appears
     as a kind-tagged ``fault`` instant, every typed error as a failed
     span or fault event."""
     trace_path = str(tmp_path / f"chaos_seed{seed}.json")
     r = chaos.run_schedule(
         seed, "mnist", tmpdir=str(tmp_path), trace_path=trace_path
-    )  # 26 families as of ISSUE 19 (native_entropy)
+    )  # 27 families as of ISSUE 20 (obs_capture)
     _check(r)
     violations = chaos.verify_trace(trace_path, r)
     assert violations == [], {
@@ -151,6 +152,14 @@ def test_tier1_seed_set_meets_the_chaos_bar():
     # bit-equal to a forced-Python stream, and an unexpected native
     # failure degrades per-image counted, never a crash
     assert "native_entropy" in kinds
+    # Fleet-observability coverage (ISSUE 20): a member SIGKILLed
+    # mid-scrape must degrade the collector (obs_member_lost,
+    # postmortem-linked), keep the fleet view monotone for survivors
+    # with counters summed and p99 pooled from raw windows, and produce
+    # ONE clock-aligned incident bundle holding every surviving member's
+    # flight ring — with serving answers bit-equal to an uncollected
+    # fleet
+    assert "obs_capture" in kinds
 
 
 def test_schedules_are_deterministic():

@@ -1,0 +1,381 @@
+"""Fleet observability plane (ISSUE 20) — the acceptance surface.
+
+The merge math must be associative and order-independent with fleet
+percentiles from POOLED raw windows (never averaged per-host
+percentiles, held to a numpy oracle); clock-aligned incident events must
+land on one monotone timeline under injected member-clock skew; the
+agent payload must ride the existing wire socket; the collector must sum
+counters exactly, degrade (never crash) on member death with the fleet
+view monotone, and write ONE schema-tagged incident bundle.  The
+subprocess drill (``dist`` marker) is the end-to-end acceptance: 2 real
+members over sockets, one SIGKILLed mid-scrape, serving bit-equal.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.core import fleetobs, telemetry, trace, wire
+from keystone_tpu.core.resilience import counters
+from keystone_tpu.workloads import multihost
+
+
+def _window(rng, n):
+    samples = np.abs(rng.normal(2.0, 1.0, size=n)).tolist()
+    return {
+        "count": n,
+        "total": float(sum(samples)),
+        "min": float(min(samples)),
+        "max": float(max(samples)),
+        "samples": samples,
+    }
+
+
+# -- merge math ----------------------------------------------------------------
+
+
+class TestMergeMath:
+    def test_merge_is_associative(self, rng):
+        ws = [_window(rng, n) for n in (5, 9, 17, 3)]
+        left = fleetobs.merge_windows(
+            [fleetobs.merge_windows(ws[:2]), fleetobs.merge_windows(ws[2:])]
+        )
+        flat = fleetobs.merge_windows(ws)
+        assert fleetobs.window_summary(left) == fleetobs.window_summary(flat)
+        assert left["count"] == flat["count"]
+        assert left["min"] == flat["min"] and left["max"] == flat["max"]
+
+    def test_merge_is_order_independent(self, rng):
+        ws = [_window(rng, n) for n in (8, 4, 12)]
+        fwd = fleetobs.window_summary(fleetobs.merge_windows(ws))
+        rev = fleetobs.window_summary(fleetobs.merge_windows(ws[::-1]))
+        # percentiles/extrema/count are exactly order-free; the mean's
+        # float summation order differs by at most an ulp
+        assert fwd.pop("mean") == pytest.approx(rev.pop("mean"))
+        assert fwd == rev
+
+    def test_single_member_fleet_summarizes_like_the_member(self, rng):
+        """A fleet of one must report exactly what the one reports — the
+        pick rule over the pooled (= its own) sorted samples."""
+        w = _window(rng, 21)
+        s = fleetobs.window_summary(fleetobs.merge_windows([w]))
+        m = trace._Hist()
+        for x in w["samples"]:
+            m.observe(x)
+        assert s["p99"] == m.summary()["p99"]
+        assert s["p50"] == m.summary()["p50"]
+        assert s["count"] == m.summary()["count"]
+
+    def test_fleet_p99_matches_pooled_numpy_oracle(self, rng):
+        """ISSUE 20 satellite: fleet p99 from merged windows vs a pooled-
+        sample numpy oracle — pooling is exact; AVERAGING the per-member
+        p99s (the anti-pattern) is measurably wrong on skewed members."""
+        slow = _window(rng, 40)
+        slow["samples"] = (np.asarray(slow["samples"]) * 50.0).tolist()
+        slow["total"] = float(sum(slow["samples"]))
+        slow["min"], slow["max"] = min(slow["samples"]), max(slow["samples"])
+        members = [_window(rng, 40), _window(rng, 40), slow]
+        merged = fleetobs.merge_windows(members)
+        fleet_p99 = fleetobs.window_summary(merged)["p99"]
+        pool = np.sort(np.concatenate([m["samples"] for m in members]))
+        assert fleet_p99 == pool[min(len(pool) - 1, int(0.99 * len(pool)))]
+        oracle = float(np.percentile(pool, 99))
+        assert abs(fleet_p99 - oracle) <= 0.25 * abs(oracle)
+        averaged = float(
+            np.mean([fleetobs.window_summary(m)["p99"] for m in members])
+        )
+        assert abs(averaged - oracle) > abs(fleet_p99 - oracle)
+
+    def test_empty_and_sampleless_windows(self):
+        assert fleetobs.window_summary(fleetobs.merge_windows([])) == {
+            "count": 0
+        }
+        no_samples = {
+            "count": 4, "total": 8.0, "min": 1.0, "max": 3.0, "samples": [],
+        }
+        s = fleetobs.window_summary(fleetobs.merge_windows([no_samples]))
+        assert s == {"count": 4, "mean": 2.0, "min": 1.0, "max": 3.0}
+
+    def test_slo_burn_pools_counts_not_rates(self):
+        """Fleet burn = pooled violations / pooled count / budget: a
+        loaded member must outweigh an idle one."""
+        busy = {
+            "slo_ms": 50.0, "budget": 0.01,
+            "window": {"count": 900, "violations": 0},
+            "total": {"requests": 900, "violations": 0},
+        }
+        idle = {
+            "slo_ms": 50.0, "budget": 0.01,
+            "window": {"count": 100, "violations": 10},
+            "total": {"requests": 100, "violations": 10},
+        }
+        m = fleetobs.merge_slo([busy, idle])
+        assert m["window"]["count"] == 1000
+        assert m["window"]["violations"] == 10
+        assert m["window"]["burn_rate"] == 1.0  # 1% rate / 1% budget
+        assert m["total"]["requests"] == 1000
+
+
+# -- clock alignment -----------------------------------------------------------
+
+
+class TestClockAlignment:
+    def test_skewed_members_land_on_one_monotone_timeline(self, rng):
+        """ISSUE 20 satellite: events that happened in a known TRUE order
+        on members whose clocks are skewed by injected offsets must come
+        out monotone (the true order) after alignment."""
+        true_ts = np.sort(rng.uniform(0, 1e6, size=30))
+        skews = {"a": 250_000.0, "b": -125_000.0, "c": 0.0}
+        owners = [list(skews)[i % 3] for i in range(30)]
+        aligned = []
+        for member, skew in skews.items():
+            evs = [
+                {"name": f"e{i}", "ph": "i", "ts": float(t + skew)}
+                for i, t in enumerate(true_ts)
+                if owners[i] == member
+            ]
+            # offset_us = member_clock - collector_clock = skew
+            aligned.extend(fleetobs.align_events(evs, skew, member))
+        aligned.sort(key=lambda e: e["ts"])
+        out_ts = [e["ts"] for e in aligned]
+        assert out_ts == sorted(out_ts)
+        np.testing.assert_allclose(out_ts, true_ts, atol=1e-6)
+        # the member's own stamp is preserved for cross-checking
+        assert all("ts_member" in e and "member" in e for e in aligned)
+
+    def test_metadata_events_pass_through_tagged(self):
+        out = fleetobs.align_events(
+            [{"ph": "M", "name": "process_name"}], 1000.0, "h0"
+        )
+        assert out == [{"ph": "M", "name": "process_name", "member": "h0"}]
+
+
+# -- agent payload over the live wire socket -----------------------------------
+
+
+class TestAgentAndCollector:
+    def test_agent_payload_schema(self):
+        trace.metrics.observe("fo_lat_ms", 3.0)
+        p = fleetobs.agent_payload()
+        assert p["schema"] == fleetobs.OBS_SCHEMA
+        assert p["pid"] == os.getpid()
+        assert p["statusz"]["schema"].startswith("keystone.statusz/")
+        assert "fo_lat_ms" in p["hist_windows"]
+        assert p["hist_windows"]["fo_lat_ms"]["samples"] == [3.0]
+        f = fleetobs.agent_payload("flight")
+        assert "flight" in f and "statusz" not in f
+
+    def test_payload_is_json_clean(self):
+        json.dumps(fleetobs.agent_payload())
+        json.dumps(fleetobs.agent_payload("flight"))
+
+    def test_collector_sums_counters_and_pools_histograms(self, tmp_path):
+        trace.metrics.inc("fo_widgets", 5)
+        trace.metrics.observe("fo_pool_ms", 1.0)
+        trace.metrics.observe("fo_pool_ms", 9.0)
+        with fleetobs.ObsAgent(label="t1") as a1, fleetobs.ObsAgent(
+            label="t2"
+        ) as a2:
+            col = fleetobs.FleetCollector(
+                [("127.0.0.1", a1.port), ("127.0.0.1", a2.port)],
+                interval_s=30.0, label="t",
+            )
+            with col:
+                snap = col.scrape_once()
+                assert snap["schema"] == fleetobs.FLEET_STATUSZ_SCHEMA
+                assert snap["alive"] == 2 and snap["lost"] == 0
+                # both members are THIS process: fleet sum is exactly 2x
+                assert snap["counters"]["fo_widgets"] == 10
+                h = snap["histograms"]["fo_pool_ms"]
+                assert h["count"] == 4 and h["max"] == 9.0
+                prom = col.fleet_prometheus()
+                assert f'keystone_fo_widgets{{host="127.0.0.1:{a1.port}"}} 5' in prom
+                assert "keystone_fleet_fo_widgets 10" in prom
+                assert "keystone_fleet_members_alive 2" in prom
+                assert col.fleet_healthz() == {
+                    "ok": True, "degraded": False, "alive": 2, "members": 2,
+                }
+
+    def test_member_death_degrades_counted_and_stays_monotone(
+        self, tmp_path
+    ):
+        """A dead member: counted ``obs_member_lost`` (postmortem family),
+        fleet DEGRADED not crashed, its last-known counters retained so
+        the fleet totals never step backwards."""
+        a1 = fleetobs.ObsAgent(label="m1")
+        a2 = fleetobs.ObsAgent(label="m2")
+        col = fleetobs.FleetCollector(
+            [("127.0.0.1", a1.port), ("127.0.0.1", a2.port)],
+            interval_s=30.0, label="t", incident_dir=str(tmp_path),
+        )
+        try:
+            before_snap = col.scrape_once()
+            before = counters.counts().get("obs_member_lost", 0)
+            a2.close()
+            after_snap = col.scrape_once()  # must NOT raise
+            assert counters.counts().get("obs_member_lost", 0) == before + 1
+            assert after_snap["lost"] == 1 and after_snap["degraded"]
+            hz = col.fleet_healthz()
+            assert hz["ok"] and hz["degraded"]
+            for k, v in before_snap["counters"].items():
+                assert after_snap["counters"].get(k, 0) >= v, k
+            # the loss itself produced ONE incident bundle
+            assert len(col.incident_paths) == 1
+            doc = json.load(open(col.incident_paths[0]))
+            assert doc["schema"] == fleetobs.INCIDENT_SCHEMA
+            assert doc["trigger"]["kind"] == "obs_member_lost"
+            key1 = f"127.0.0.1:{a1.port}"
+            assert key1 in doc["members"]
+            ts = [
+                e["ts"] for e in doc["events"]
+                if isinstance(e.get("ts"), (int, float))
+            ]
+            assert ts == sorted(ts)
+            # re-scraping the dead member keeps degrading quietly: no new
+            # count (the alive->dead edge fired once), never a raise
+            col.scrape_once()
+            assert counters.counts().get("obs_member_lost", 0) == before + 1
+        finally:
+            col.close()
+            a1.close()
+            a2.close()
+
+    def test_incident_bundles_are_capped_per_kind(self, tmp_path):
+        with fleetobs.ObsAgent(label="cap") as a:
+            col = fleetobs.FleetCollector(
+                [("127.0.0.1", a.port)], interval_s=30.0,
+                incident_dir=str(tmp_path), label="cap",
+            )
+            with col:
+                col.scrape_once()
+                paths = [
+                    col.capture_incident("demo_cap", detail=f"n{i}")
+                    for i in range(fleetobs.MAX_INCIDENTS_PER_KIND + 2)
+                ]
+                written = [p for p in paths if p]
+                assert len(written) == fleetobs.MAX_INCIDENTS_PER_KIND
+
+    def test_collector_without_incident_dir_never_writes(self, tmp_path):
+        with fleetobs.ObsAgent(label="nodir") as a:
+            col = fleetobs.FleetCollector(
+                [("127.0.0.1", a.port)], interval_s=30.0, label="nodir",
+            )
+            with col:
+                col.scrape_once()
+                assert col.capture_incident("demo_nodir") is None
+                assert col.incident_paths == []
+
+    def test_register_readmits_known_endpoint(self):
+        with fleetobs.ObsAgent(label="readmit") as a:
+            col = fleetobs.FleetCollector(interval_s=30.0, label="r")
+            with col:
+                col.register(("127.0.0.1", a.port), rank=0)
+                col.scrape_once()
+                key = f"127.0.0.1:{a.port}"
+                col._members[key]["alive"] = False  # simulate a loss
+                col.register(("127.0.0.1", a.port))
+                assert col.members()[key]["alive"]
+                assert len(col.members()) == 1  # revived, not duplicated
+
+    def test_obs_frames_live_on_the_serving_socket(self):
+        """The serving endpoint IS the obs endpoint: one WireServer
+        answers predict AND obs frames."""
+
+        class _Ready:
+            def __init__(self, v):
+                self._v = v
+
+            def result(self, timeout=None):
+                return self._v
+
+        class _Doubler:
+            def submit(self, arr):
+                return _Ready(np.asarray(arr) * 2.0)
+
+            def record(self):
+                return {}
+
+        s = wire.WireServer(_Doubler(), port=0, label="obs_serve")
+        try:
+            c = wire.WireClient("127.0.0.1", s.port, timeout=10.0)
+            try:
+                np.testing.assert_array_equal(
+                    np.asarray(c.predict(np.ones(4, np.float32))),
+                    np.full(4, 2.0, np.float32),
+                )
+                snap = c.obs_snapshot()
+                assert snap["pid"] == os.getpid()
+                flight = c.obs_flight()
+                assert isinstance(flight["flight"], list)
+            finally:
+                c.close()
+        finally:
+            s.close()
+
+
+# -- HostFleet wiring ----------------------------------------------------------
+
+
+def test_hostfleet_attach_collector_registers_and_readmits():
+    with fleetobs.ObsAgent(label="fa") as a1, fleetobs.ObsAgent(
+        label="fb"
+    ) as a2:
+        eps = [("127.0.0.1", a1.port), ("127.0.0.1", a2.port)]
+        col = fleetobs.FleetCollector(interval_s=30.0, label="hf")
+        with col, kfleet_ctx(eps) as fleet:
+            fleet.attach_collector(col)
+            assert set(col.members()) == {
+                f"127.0.0.1:{a1.port}", f"127.0.0.1:{a2.port}"
+            }
+            col._members[f"127.0.0.1:{a2.port}"]["alive"] = False
+            fleet.reattach(("127.0.0.1", a2.port))
+            assert col.members()[f"127.0.0.1:{a2.port}"]["alive"]
+
+
+def kfleet_ctx(eps):
+    from keystone_tpu.core import frontend as kfrontend
+
+    return kfrontend.HostFleet(eps, label="obs_hf")
+
+
+# -- the end-to-end acceptance drill ------------------------------------------
+
+
+@pytest.mark.dist
+def test_obs_capture_drill_subprocess_acceptance(tmp_path):
+    """ISSUE 20 acceptance: 2 REAL subprocess members over sockets with
+    the collector attached — (a) fleet counters equal the sum of
+    per-member snapshots, (b) fleet p99 from merged windows matches the
+    pooled-sample oracle, (c) after one member is SIGKILLed mid-scrape,
+    ONE incident bundle holds every surviving member's flight ring on a
+    monotone clock-aligned timeline — and every request answers bit-equal
+    to the offline oracle (zero dropped)."""
+    rec = multihost.run_obs_capture_drill(
+        str(tmp_path), hosts=2, requests=16, subprocess_mode=True,
+        timeout_s=180.0,
+    )
+    assert rec["counter_sum_ok"], rec.get("counter_sum_mismatch")
+    assert rec["p99_match"], {
+        k: rec.get(k)
+        for k in ("p99_fleet", "p99_oracle_pick", "p99_oracle_np")
+    }
+    assert rec["monotone_ok"], rec.get("monotone_violations")
+    assert rec["obs_member_lost"] >= 1
+    assert rec["dropped_requests"] == 0
+    assert rec["mismatches"] == 0
+    inc = rec["incident"]
+    assert inc["schema"] == fleetobs.INCIDENT_SCHEMA
+    assert inc["survivor_rings_ok"], inc
+    assert inc["events_monotone"], inc
+    assert rec["fleet_alive"] == 1 and rec["fleet_lost"] == 1
+    assert any("obs_member_lost" in p for p in rec["postmortems"])
+
+
+# -- labeled exposition rides the fleet renderer -------------------------------
+
+
+def test_fleet_prometheus_uses_labeled_exposition():
+    lbl = telemetry.render_labels({"host": "h0", "rank": 1})
+    assert lbl == '{host="h0",rank="1"}'

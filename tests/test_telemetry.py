@@ -131,6 +131,73 @@ def test_prometheus_text_golden():
     )
 
 
+def test_prometheus_text_labeled_golden():
+    """ISSUE 20: the same fixed snapshot rendered with ``host``/``rank``
+    labels — every sample line carries the sorted label block, the
+    histogram quantile label composes AFTER the member labels, and the
+    TYPE lines stay label-free (exposition-format exact)."""
+    m = trace.Metrics()
+    m.inc("alpha_total", 3)
+    m.gauge("queue_depth", 2.5)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        m.observe("lat_ms", v)
+
+    class Group:
+        def snapshot(self, reset=False):
+            return {"corrupt_image": 2}
+
+    m.adopt("faults", Group())
+    text = telemetry.prometheus_text(
+        m.snapshot(), labels={"host": "h0", "rank": 0}
+    )
+    assert text == textwrap.dedent(
+        """\
+        # TYPE keystone_alpha_total counter
+        keystone_alpha_total{host="h0",rank="0"} 3
+        # TYPE keystone_queue_depth gauge
+        keystone_queue_depth{host="h0",rank="0"} 2.5
+        # TYPE keystone_lat_ms summary
+        keystone_lat_ms{host="h0",rank="0",quantile="0.50"} 3.0
+        keystone_lat_ms{host="h0",rank="0",quantile="0.90"} 4.0
+        keystone_lat_ms{host="h0",rank="0",quantile="0.99"} 4.0
+        keystone_lat_ms_sum{host="h0",rank="0"} 10.0
+        keystone_lat_ms_count{host="h0",rank="0"} 4
+        # TYPE keystone_faults_corrupt_image counter
+        keystone_faults_corrupt_image{host="h0",rank="0"} 2
+        """
+    )
+
+
+def test_render_labels_sorts_escapes_and_skips_none():
+    assert telemetry.render_labels(None) == ""
+    assert telemetry.render_labels({}) == ""
+    assert telemetry.render_labels({"rank": None}) == ""
+    assert (
+        telemetry.render_labels({"b": 'say "hi"\n', "a": "x\\y"})
+        == '{a="x\\\\y",b="say \\"hi\\"\\n"}'
+    )
+    assert (
+        telemetry.render_labels({"host": "h0"}, extra='quantile="0.99"')
+        == '{host="h0",quantile="0.99"}'
+    )
+    assert telemetry.render_labels({}, extra='quantile="0.99"') == (
+        '{quantile="0.99"}'
+    )
+
+
+def test_prometheus_text_without_labels_is_byte_identical():
+    """labels=None must not perturb the un-labeled exposition the
+    original golden test pins (single-process scrapes keep their bytes)."""
+    m = trace.Metrics()
+    m.inc("alpha_total", 3)
+    assert telemetry.prometheus_text(m.snapshot()) == telemetry.prometheus_text(
+        m.snapshot(), labels=None
+    )
+    assert telemetry.prometheus_text(m.snapshot(), labels={}) == (
+        telemetry.prometheus_text(m.snapshot())
+    )
+
+
 def test_prometheus_text_sanitizes_names_and_skips_non_numeric():
     m = trace.Metrics()
     m.inc("weird.name-with/chars")

@@ -165,6 +165,34 @@ DEFAULT_METRICS: tuple = (
     ("extra_metrics.lifecycle.swap_wall_s", "lower", 0.50),
     ("extra_metrics.lifecycle.drift_to_healthy_wall_s", "lower", 0.50),
     ("extra_metrics.lifecycle.dropped_requests", "lower", 0.00),
+    # ISSUE 20: fleet observability plane — the live fleet-scrape and
+    # pure window-merge walls must not creep across rounds, the attached
+    # collector must not start costing the endpoint real tail latency
+    # (the <= 5% acceptance is recorded in-round as target_frac; the
+    # frac row gets the same loose threshold as the numerics tier
+    # because a ratio of two noisy p99s swings hard on shared boxes),
+    # the one-file incident capture must stay fast, and the obs-capture
+    # drill must never drop a request across the member kill (zero
+    # stays zero).
+    ("extra_metrics.fleet_observability.scrape_wall_s", "lower", 1.00),
+    ("extra_metrics.fleet_observability.merge_wall_s", "lower", 1.00),
+    (
+        "extra_metrics.fleet_observability.collector_overhead.p99_on_ms",
+        "lower", 0.50,
+    ),
+    (
+        "extra_metrics.fleet_observability.collector_overhead."
+        "collector_overhead_frac",
+        "lower", 1.00,
+    ),
+    (
+        "extra_metrics.fleet_observability.incident_capture_wall_s",
+        "lower", 1.00,
+    ),
+    (
+        "extra_metrics.fleet_observability.drill.dropped_requests",
+        "lower", 0.00,
+    ),
 )
 
 

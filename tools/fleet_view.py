@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Fleet observability viewer (ISSUE 20): the live fleet table and the
+clock-aligned incident-bundle timeline as human tables.
+
+Three input modes (any combination):
+
+* ``--endpoints host:port,host:port`` — one-shot scrape of live fleet
+  members (every :class:`~keystone_tpu.core.wire.WireServer` answers the
+  obs frames): member table, fleet counter totals, pooled-window
+  histogram summaries, fleet health verdict.
+* ``--statusz fleet.json`` — render a saved fleet-statusz snapshot
+  (``keystone.fleet_statusz/1``, e.g. from a bench record or a collector
+  dump) through the same tables.
+* ``--incident incident_*.json`` — render an incident bundle
+  (``keystone.incident/1``): the trigger, the per-member ring inventory
+  (clock offset, rtt, event counts), and the merged timeline — every
+  member's flight events on the COLLECTOR's clock, interleaved in true
+  order (``--events N`` bounds the tail shown, default 40).
+
+Usage:
+    python tools/fleet_view.py --endpoints 127.0.0.1:7070,127.0.0.1:7071
+    python tools/fleet_view.py --incident incident_obs_member_lost_12_0.json
+
+Exit status: 0 = rendered, 2 = nothing renderable (no input given, an
+unreadable file, or an unreachable fleet).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.health_view import _fmt, _table  # noqa: E402
+
+
+def render_fleet_statusz(snap: dict) -> str:
+    """The fleet tables for one merged snapshot (collector
+    ``fleet_statusz()`` / ``keystone.fleet_statusz/1``)."""
+    parts: list[str] = []
+    members = snap.get("members") or {}
+    if members:
+        rows = []
+        for key in sorted(members):
+            m = members[key]
+            rows.append([
+                key,
+                _fmt(m.get("rank")),
+                "up" if m.get("alive") else "LOST",
+                _fmt(m.get("pid")),
+                _fmt(m.get("scrapes")),
+                _fmt(m.get("failures")),
+                _fmt(m.get("offset_us"), 6),
+                _fmt(m.get("rtt_us"), 4),
+            ])
+        parts.append("== fleet members ==\n" + _table(
+            ["member", "rank", "state", "pid", "scrapes", "failures",
+             "clock_offset_us", "rtt_us"],
+            rows,
+        ))
+    verdict = (
+        f"fleet '{snap.get('label', '-')}': "
+        f"{snap.get('alive', 0)}/{len(members) or snap.get('alive', 0)} "
+        f"member(s) up"
+        + (" — DEGRADED" if snap.get("degraded") else "")
+        + f" (scrapes: {snap.get('scrapes', 0)})"
+    )
+    parts.append(verdict)
+    counters = dict(snap.get("counters") or {})
+    for k, v in (snap.get("faults") or {}).items():
+        counters.setdefault(k, v)
+    if counters:
+        rows = [[k, _fmt(counters[k])] for k in sorted(counters)]
+        parts.append("== fleet counters (summed) ==\n" + _table(
+            ["counter", "total"], rows,
+        ))
+    hists = snap.get("histograms") or {}
+    if hists:
+        rows = []
+        for name in sorted(hists):
+            h = hists[name]
+            rows.append([
+                name,
+                _fmt(h.get("count")),
+                _fmt(h.get("mean")),
+                _fmt(h.get("p50")),
+                _fmt(h.get("p90")),
+                _fmt(h.get("p99")),
+                _fmt(h.get("max")),
+            ])
+        parts.append(
+            "== fleet latency (pooled windows, not averaged "
+            "percentiles) ==\n"
+            + _table(
+                ["histogram", "count", "mean", "p50", "p90", "p99", "max"],
+                rows,
+            )
+        )
+    slo = snap.get("slo") or {}
+    if slo:
+        rows = []
+        for label in sorted(slo):
+            s = slo[label]
+            w = s.get("window", {})
+            rows.append([
+                label,
+                _fmt(s.get("slo_ms")),
+                _fmt(s.get("budget")),
+                _fmt(w.get("count")),
+                _fmt(w.get("violations")),
+                _fmt(w.get("burn_rate")),
+            ])
+        parts.append("== fleet SLO (pooled error budget) ==\n" + _table(
+            ["slo", "slo_ms", "budget", "window_n", "violations",
+             "burn_rate"],
+            rows,
+        ))
+    return "\n\n".join(parts)
+
+
+def render_incident(doc: dict, max_events: int = 40) -> str:
+    """The incident bundle: trigger, per-member ring inventory, and the
+    tail of the merged clock-aligned timeline."""
+    parts: list[str] = []
+    trig = doc.get("trigger") or {}
+    parts.append(
+        f"incident {doc.get('schema', '?')}  "
+        f"trigger={trig.get('kind', '?')}  member={trig.get('member', '-')}\n"
+        f"  {trig.get('detail', '')}".rstrip()
+    )
+    members = doc.get("members") or {}
+    if members:
+        rows = []
+        for key in sorted(members):
+            m = members[key]
+            rows.append([
+                key,
+                _fmt(m.get("rank")),
+                _fmt(m.get("pid")),
+                _fmt(m.get("offset_us"), 6),
+                _fmt(m.get("rtt_us"), 4),
+                _fmt(m.get("events")),
+            ])
+        parts.append("== member flight rings ==\n" + _table(
+            ["member", "rank", "pid", "clock_offset_us", "rtt_us",
+             "events"],
+            rows,
+        ))
+    missing = doc.get("missing") or []
+    if missing:
+        parts.append(
+            "missing (unreachable within the capture window): "
+            + ", ".join(missing)
+        )
+    events = [
+        e for e in (doc.get("events") or [])
+        if isinstance(e.get("ts"), (int, float))
+    ]
+    if events:
+        tail = events[-max_events:]
+        rows = []
+        for e in tail:
+            args = e.get("args") or {}
+            detail = ", ".join(
+                f"{k}={v}" for k, v in list(args.items())[:3]
+            )
+            rows.append([
+                f"{e['ts'] / 1e6:.6f}",
+                str(e.get("member", "-")),
+                str(e.get("ph", "-")),
+                str(e.get("name", "-"))[:40],
+                _fmt(e.get("dur")),
+                detail[:60],
+            ])
+        parts.append(
+            f"== clock-aligned timeline (last {len(tail)} of "
+            f"{len(events)} events, collector seconds) ==\n"
+            + _table(["t_s", "member", "ph", "event", "dur_us", "detail"],
+                     rows)
+        )
+    return "\n\n".join(parts)
+
+
+def scrape_endpoints(endpoints: str, timeout: float = 10.0) -> dict:
+    """One-shot collector over ``host:port,host:port`` — scrape, merge,
+    return the fleet statusz snapshot."""
+    from keystone_tpu.core import fleetobs
+
+    col = fleetobs.FleetCollector(
+        [e.strip() for e in endpoints.split(",") if e.strip()],
+        interval_s=3600.0, label="fleet_view", timeout=timeout,
+    )
+    with col:
+        return col.scrape_once()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("fleet_view")
+    p.add_argument(
+        "--endpoints",
+        help="comma-separated host:port members to scrape one-shot",
+    )
+    p.add_argument(
+        "--statusz", help="saved fleet-statusz JSON to render"
+    )
+    p.add_argument(
+        "--incident", help="incident bundle JSON to render as a timeline"
+    )
+    p.add_argument(
+        "--events", type=int, default=40,
+        help="max timeline events shown from an incident bundle",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=10.0,
+        help="per-member scrape timeout (seconds)",
+    )
+    a = p.parse_args(argv)
+    if not (a.endpoints or a.statusz or a.incident):
+        p.print_usage(sys.stderr)
+        print(
+            "fleet_view: need --endpoints, --statusz, or --incident",
+            file=sys.stderr,
+        )
+        return 2
+    parts: list[str] = []
+    if a.endpoints:
+        snap = scrape_endpoints(a.endpoints, timeout=a.timeout)
+        if not snap.get("alive"):
+            print(
+                f"fleet_view: no member of {a.endpoints} answered",
+                file=sys.stderr,
+            )
+            return 2
+        parts.append(render_fleet_statusz(snap))
+    if a.statusz:
+        try:
+            with open(a.statusz) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(
+                f"fleet_view: cannot read {a.statusz}: {e}", file=sys.stderr
+            )
+            return 2
+        # accept a bench/serve_bench record embedding the snapshot
+        if doc.get("schema") != "keystone.fleet_statusz/1":
+            for key in ("fleet_statusz", "fleet_obs"):
+                inner = doc.get(key)
+                if isinstance(inner, dict):
+                    doc = inner.get("statusz", inner)
+                    break
+        parts.append(render_fleet_statusz(doc))
+    if a.incident:
+        try:
+            with open(a.incident) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(
+                f"fleet_view: cannot read {a.incident}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        if doc.get("schema") != "keystone.incident/1":
+            print(
+                f"fleet_view: {a.incident} is not an incident bundle "
+                f"(schema {doc.get('schema')!r})",
+                file=sys.stderr,
+            )
+            return 2
+        parts.append(render_incident(doc, max_events=a.events))
+    print("\n\n".join(parts))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -256,12 +256,79 @@ def render(numerics: dict) -> str:
     return "\n\n".join(parts)
 
 
+def _find_fleet_statusz(doc) -> dict:
+    """The fleet-statusz snapshot inside ``doc`` (the document itself, a
+    collector dump, or a bench/serve_bench record embedding one)."""
+    if not isinstance(doc, dict):
+        return {}
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if doc.get("schema") == "keystone.fleet_statusz/1":
+        return doc
+    for path in (
+        ("fleet_obs", "statusz"),
+        ("fleet_obs",),
+        ("fleet_statusz",),
+        ("extra_metrics", "fleet_observability", "statusz"),
+    ):
+        node = doc
+        for part in path:
+            node = node.get(part) if isinstance(node, dict) else None
+        if (
+            isinstance(node, dict)
+            and node.get("schema") == "keystone.fleet_statusz/1"
+        ):
+            return node
+    return {}
+
+
+def render_fleet(doc) -> str:
+    """ISSUE 20 ``--fleet``: the merged fleet snapshot (or an incident
+    bundle) through one tool — the fleet tables first, then every
+    member's numerics/lifecycle surfaces through the SAME per-site tables
+    the single-process view uses."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools import fleet_view
+
+    if isinstance(doc, dict) and doc.get("schema") == "keystone.incident/1":
+        return fleet_view.render_incident(doc)
+    snap = _find_fleet_statusz(doc)
+    if not snap:
+        return ""
+    parts = [fleet_view.render_fleet_statusz(snap)]
+    for key in sorted(snap.get("member_statusz") or {}):
+        stz = snap["member_statusz"][key]
+        member_parts = [
+            s
+            for s in (
+                render(extract_numerics(stz)),
+                render_lifecycle(extract_lifecycle(stz)),
+            )
+            if s
+        ]
+        if member_parts:
+            parts.append(
+                f"---- member {key} ----\n" + "\n\n".join(member_parts)
+            )
+    return "\n\n".join(parts)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("health_view")
     p.add_argument(
         "record",
-        help="postmortem dump, /statusz snapshot, bench round, or workload "
-        "results JSON",
+        help="postmortem dump, /statusz snapshot, bench round, workload "
+        "results, fleet statusz, or incident bundle JSON",
+    )
+    p.add_argument(
+        "--fleet",
+        action="store_true",
+        help="render a fleet-collector snapshot (or incident bundle): "
+        "fleet tables plus every member's numerics/lifecycle surfaces",
     )
     a = p.parse_args(argv)
     try:
@@ -270,6 +337,18 @@ def main(argv=None) -> int:
     except (OSError, json.JSONDecodeError) as e:
         print(f"health_view: cannot read {a.record}: {e}", file=sys.stderr)
         return 2
+    if a.fleet:
+        out = render_fleet(doc)
+        if not out:
+            print(
+                f"health_view: no fleet statusz or incident bundle in "
+                f"{a.record} — scrape one with tools/fleet_view.py "
+                "--endpoints or pass a collector incident file",
+                file=sys.stderr,
+            )
+            return 2
+        print(out)
+        return 0
     numerics = extract_numerics(doc)
     lifecycle = extract_lifecycle(doc)
     if not numerics and not lifecycle:

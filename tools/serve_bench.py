@@ -542,6 +542,7 @@ def run_hosts(a) -> int:
     errors: list = []
     results: list = [None] * n
     lat_ms: list = [None] * n
+    col = None
     try:
         for r in range(a.hosts):
             env = mh._hermetic_env(
@@ -561,6 +562,14 @@ def run_hosts(a) -> int:
             idx_q.put(i)
 
         with kfrontend.HostFleet(endpoints, label="serve_bench") as fleet:
+            if a.collect:
+                from keystone_tpu.core import fleetobs
+
+                col = fleetobs.FleetCollector(
+                    label="serve_bench", interval_s=0.2
+                )
+                fleet.attach_collector(col)
+                col.start()
 
             def work():
                 while True:
@@ -607,6 +616,30 @@ def run_hosts(a) -> int:
                 t.join(max(0.1, end - time.monotonic()))
             if any(t.is_alive() for t in pool):
                 errors.append("fleet clients did not drain in time")
+            if col is not None:
+                from keystone_tpu.core import resilience
+
+                col.stop()
+                snap = col.scrape_once()
+                hists = snap.get("histograms") or {}
+                metric = next(
+                    (m for m in ("serve_latency_ms", "wire_request_ms")
+                     if m in hists),
+                    None,
+                )
+                p99 = (hists.get(metric) or {}).get("p99")
+                record["fleet_obs"] = {
+                    "statusz": snap,
+                    "pooled_metric": metric,
+                    "fleet_p99_ms": round(p99, 3) if p99 is not None
+                    else None,
+                    "alive": snap.get("alive"),
+                    "lost": snap.get("lost"),
+                    # Counted in THIS (collector) process, not a member.
+                    "obs_member_lost": int(
+                        resilience.counters.get("obs_member_lost")
+                    ),
+                }
             record["fleet"] = fleet.record()
         live = [r for r in range(a.hosts) if r != a.kill_host]
         for r in live:
@@ -616,6 +649,8 @@ def run_hosts(a) -> int:
             for r in live
         }
     finally:
+        if col is not None:
+            col.close()
         record["worker_rcs"] = [w.finish() for w in workers]
 
     answered = sorted(v for v in lat_ms if v is not None)
@@ -646,6 +681,14 @@ def run_hosts(a) -> int:
             f"# host-loss: killed host {a.kill_host} at "
             f"{record.get('killed_at_answered')} answered, reanchor wall "
             f"{record.get('reanchor_wall_s')}s, {dropped} dropped"
+        )
+    fo = record.get("fleet_obs")
+    if fo:
+        print(
+            f"# fleet-obs: {fo['alive']}/{a.hosts} member(s) up, fleet "
+            f"p99 {fo['fleet_p99_ms']}ms from pooled "
+            f"{fo['pooled_metric']} windows, "
+            f"member_lost={fo['obs_member_lost']}"
         )
     for err in errors:
         print(f"# ERROR {err}")
@@ -697,6 +740,13 @@ def main(argv=None) -> int:
         "lost requests or exit 1",
     )
     p.add_argument(
+        "--collect", action="store_true",
+        help="with --hosts (ISSUE 20): attach a fleet collector scraping "
+        "the workers over the obs wire frames — the record gains "
+        "fleet_obs: the merged fleet statusz plus the fleet p99 from "
+        "pooled latency windows (never averaged percentiles)",
+    )
+    p.add_argument(
         "--drift-refit", action="store_true",
         help="closed-lifecycle drill (ISSUE 18): trip the drift monitor "
         "with a shifted mix, warm-refit, validate, hot-swap with requests "
@@ -709,6 +759,8 @@ def main(argv=None) -> int:
         return run_drift_refit(a)
     if a.kill_host is not None and a.hosts is None:
         p.error("--kill-host requires --hosts")
+    if a.collect and a.hosts is None:
+        p.error("--collect requires --hosts")
     if a.hosts is not None:
         if a.hosts < 2:
             p.error("--hosts must be >= 2 (a fleet)")
